@@ -1,0 +1,596 @@
+"""Flat BlueFog-compatible op API.
+
+Mirrors ``bluefog.torch``'s public surface (reference
+bluefog/torch/__init__.py:34-110, bluefog/torch/mpi_ops.py,
+bluefog/common/basics.py) on rank-major JAX arrays.  Every tensor argument
+and result is a global array of shape ``[size, ...]`` sharded over the rank
+mesh axis — slice r is rank r's tensor.  Nonblocking variants return an int
+handle; ``synchronize(handle)`` gives the array (JAX async dispatch makes
+the "nonblocking" real: the program is enqueued, not executed, when the
+handle returns).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from bluefog_tpu import config as bfconfig
+from bluefog_tpu import context as ctx_mod
+from bluefog_tpu import timeline as timeline_mod
+from bluefog_tpu.context import AXIS, BluefogContext, BluefogError, get_context
+from bluefog_tpu.logging_util import get_logger
+from bluefog_tpu.parallel import collectives as C
+from bluefog_tpu.topology.graphs import ExponentialGraph
+from bluefog_tpu.windows import WindowManager, win_lock_ctx, win_mutex_ctx
+
+logger = get_logger()
+
+_win_manager: Optional[WindowManager] = None
+
+
+# ------------------------------------------------------------------ #
+# lifecycle (reference basics.py:49-76)
+# ------------------------------------------------------------------ #
+def init(topology_fn=None, is_weighted: bool = False, *,
+         devices=None, local_size: Optional[int] = None) -> None:
+    """Initialize the global context over ``devices`` (default: all).
+
+    ``topology_fn``: callable returning the virtual topology; called with
+    the world size if it accepts an argument (reference basics.py:49-69 —
+    default ExponentialGraph).
+    """
+    global _win_manager
+    ctx = BluefogContext(devices=devices, local_size=local_size)
+    ctx_mod.set_context(ctx)
+    _win_manager = WindowManager(ctx)
+    if topology_fn is not None:
+        try:
+            topo = topology_fn(ctx.size())
+        except TypeError:
+            topo = topology_fn()
+    else:
+        topo = ExponentialGraph(ctx.size())
+    if not ctx.set_topology(topo, is_weighted):
+        raise BluefogError("Failed to set initial topology.")
+    tl_path = bfconfig.timeline_path()
+    if tl_path:
+        ctx.timeline = timeline_mod.start_timeline(tl_path, rank=jax.process_index())
+
+
+def shutdown() -> None:
+    global _win_manager
+    timeline_mod.stop_timeline()
+    _win_manager = None
+    ctx_mod.set_context(None)
+
+
+def is_initialized() -> bool:
+    return ctx_mod.is_initialized()
+
+
+def _wm() -> WindowManager:
+    if _win_manager is None:
+        raise BluefogError("BlueFog-TPU is not initialized; call init() first.")
+    return _win_manager
+
+
+# ------------------------------------------------------------------ #
+# introspection (reference basics.py:78-265)
+# ------------------------------------------------------------------ #
+def size() -> int:
+    return get_context().size()
+
+
+def local_size() -> int:
+    return get_context().local_size()
+
+
+def rank() -> int:
+    return get_context().rank()
+
+
+def local_rank() -> int:
+    return get_context().local_rank()
+
+
+def machine_size() -> int:
+    return get_context().machine_size()
+
+
+def machine_rank() -> int:
+    return get_context().machine_rank()
+
+
+def is_homogeneous() -> bool:
+    return get_context().is_homogeneous()
+
+
+def load_topology():
+    return get_context().load_topology()
+
+
+def is_topo_weighted() -> bool:
+    return get_context().is_topo_weighted()
+
+
+def set_topology(topology=None, is_weighted: bool = False) -> bool:
+    return get_context().set_topology(topology, is_weighted)
+
+
+def load_machine_topology():
+    return get_context().load_machine_topology()
+
+
+def is_machine_topo_weighted() -> bool:
+    return get_context().is_machine_topo_weighted()
+
+
+def set_machine_topology(topology, is_weighted: bool = False) -> bool:
+    return get_context().set_machine_topology(topology, is_weighted)
+
+
+def in_neighbor_ranks(rank: Optional[int] = None) -> List[int]:
+    return get_context().in_neighbor_ranks(rank)
+
+
+def out_neighbor_ranks(rank: Optional[int] = None) -> List[int]:
+    return get_context().out_neighbor_ranks(rank)
+
+
+def in_neighbor_machine_ranks(machine_rank: Optional[int] = None) -> List[int]:
+    return get_context().in_neighbor_machine_ranks(machine_rank)
+
+
+def out_neighbor_machine_ranks(machine_rank: Optional[int] = None) -> List[int]:
+    return get_context().out_neighbor_machine_ranks(machine_rank)
+
+
+def suspend():
+    get_context().suspend()
+
+
+def resume():
+    get_context().resume()
+
+
+def set_skip_negotiate_stage(value: bool):
+    get_context().set_skip_negotiate_stage(value)
+
+
+def get_skip_negotiate_stage() -> bool:
+    return get_context().get_skip_negotiate_stage()
+
+
+def mpi_threads_supported() -> bool:
+    """Parity shim — there is no MPI; SPMD dispatch is thread-safe."""
+    return True
+
+
+def unified_mpi_window_model_supported() -> bool:
+    """Parity shim (reference basics.py unified window check)."""
+    return True
+
+
+def nccl_built() -> bool:
+    """Parity shim — the data plane is XLA over ICI/DCN, not NCCL."""
+    return False
+
+
+# ------------------------------------------------------------------ #
+# rank-major array helpers (TPU-build addition)
+# ------------------------------------------------------------------ #
+def rank_sharded(array) -> jax.Array:
+    return get_context().rank_sharded(array)
+
+
+def from_rank_values(values) -> jax.Array:
+    return get_context().from_rank_values(values)
+
+
+def to_rank_values(array) -> List[np.ndarray]:
+    return get_context().to_rank_values(array)
+
+
+# ------------------------------------------------------------------ #
+# collectives (reference mpi_ops.py)
+# ------------------------------------------------------------------ #
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              is_hierarchical_local: bool = False) -> jax.Array:
+    return synchronize(
+        allreduce_nonblocking(tensor, average, name, is_hierarchical_local)
+    )
+
+
+def allreduce_nonblocking(tensor, average: bool = True,
+                          name: Optional[str] = None,
+                          is_hierarchical_local: bool = False) -> int:
+    ctx = get_context()
+    if is_hierarchical_local:
+        groups = C.machine_groups(ctx.size(), ctx.local_size())
+        local = ctx.local_size()
+
+        def kernel(x, _groups=groups, _local=local, _avg=average):
+            import jax.numpy as jnp
+            from jax import lax
+            acc = lax.psum(x.astype(jnp.float32), AXIS, axis_index_groups=_groups)
+            if _avg:
+                acc = acc / _local
+            return acc.astype(x.dtype)
+
+        out = ctx.run_op(("allreduce_local", average, ctx.local_size()), kernel, tensor)
+    else:
+        out = ctx.run_op(("allreduce", average),
+                         lambda x: C.allreduce(x, AXIS, average), tensor)
+    return ctx.register_handle(name, "allreduce", out)
+
+
+def allreduce_(tensor, average: bool = True, name: Optional[str] = None) -> jax.Array:
+    """In-place spelling kept for parity; JAX arrays are immutable, so this
+    returns the new array (callers rebind)."""
+    return allreduce(tensor, average, name)
+
+
+def allreduce_nonblocking_(tensor, average: bool = True,
+                           name: Optional[str] = None) -> int:
+    return allreduce_nonblocking(tensor, average, name)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None) -> jax.Array:
+    return synchronize(broadcast_nonblocking(tensor, root_rank, name))
+
+
+def broadcast_nonblocking(tensor, root_rank: int,
+                          name: Optional[str] = None) -> int:
+    ctx = get_context()
+    out = ctx.run_op(("broadcast", root_rank),
+                     lambda x: C.broadcast(x, root_rank, AXIS), tensor)
+    return ctx.register_handle(name, "broadcast", out)
+
+
+def broadcast_(tensor, root_rank: int, name: Optional[str] = None) -> jax.Array:
+    return broadcast(tensor, root_rank, name)
+
+
+def broadcast_nonblocking_(tensor, root_rank: int,
+                           name: Optional[str] = None) -> int:
+    return broadcast_nonblocking(tensor, root_rank, name)
+
+
+def allgather(tensor, name: Optional[str] = None) -> jax.Array:
+    return synchronize(allgather_nonblocking(tensor, name))
+
+
+def allgather_nonblocking(tensor, name: Optional[str] = None) -> int:
+    ctx = get_context()
+    out = ctx.run_op(("allgather",), lambda x: C.allgather(x, AXIS), tensor)
+    return ctx.register_handle(name, "allgather", out)
+
+
+def neighbor_allreduce(tensor, *, self_weight=None, src_weights=None,
+                       dst_weights=None, enable_topo_check: bool = True,
+                       name: Optional[str] = None) -> jax.Array:
+    return synchronize(neighbor_allreduce_nonblocking(
+        tensor, self_weight=self_weight, src_weights=src_weights,
+        dst_weights=dst_weights, enable_topo_check=enable_topo_check,
+        name=name))
+
+
+def neighbor_allreduce_nonblocking(tensor, *, self_weight=None,
+                                   src_weights=None, dst_weights=None,
+                                   enable_topo_check: bool = True,
+                                   name: Optional[str] = None) -> int:
+    ctx = get_context()
+    spec, _dynamic = ctx.resolve_neighbor_spec(
+        self_weight, src_weights, dst_weights,
+        enable_topo_check=enable_topo_check)
+    out = ctx.run_op(("neighbor_allreduce", spec.digest()),
+                     lambda x: C.neighbor_allreduce(x, spec, AXIS), tensor)
+    return ctx.register_handle(name, "neighbor_allreduce", out)
+
+
+def hierarchical_neighbor_allreduce(tensor, *, self_weight=None,
+                                    src_machine_weights=None,
+                                    dst_machine_weights=None,
+                                    enable_topo_check: bool = False,
+                                    name: Optional[str] = None) -> jax.Array:
+    return synchronize(hierarchical_neighbor_allreduce_nonblocking(
+        tensor, self_weight=self_weight,
+        src_machine_weights=src_machine_weights,
+        dst_machine_weights=dst_machine_weights,
+        enable_topo_check=enable_topo_check, name=name))
+
+
+def hierarchical_neighbor_allreduce_nonblocking(
+        tensor, *, self_weight=None, src_machine_weights=None,
+        dst_machine_weights=None, enable_topo_check: bool = False,
+        name: Optional[str] = None) -> int:
+    ctx = get_context()
+    if ctx.load_machine_topology() is None and (
+            self_weight is None and src_machine_weights is None):
+        raise BluefogError(
+            "hierarchical_neighbor_allreduce needs set_machine_topology() "
+            "or explicit machine weights."
+        )
+    spec, _dynamic = ctx.resolve_neighbor_spec(
+        self_weight, src_machine_weights, dst_machine_weights,
+        machine_level=True)
+    local = ctx.local_size()
+    out = ctx.run_op(
+        ("hierarchical_neighbor_allreduce", spec.digest(), local),
+        lambda x: C.hierarchical_neighbor_allreduce(x, spec, local, AXIS),
+        tensor)
+    return ctx.register_handle(name, "hierarchical_neighbor_allreduce", out)
+
+
+def neighbor_allgather(tensor, *, src_ranks=None, dst_ranks=None,
+                       enable_topo_check: bool = True,
+                       name: Optional[str] = None):
+    """Concatenate in-neighbor tensors along dim 0 (reference
+    torch/mpi_ops.py:400-476).  Returns a rank-major array
+    ``[size, in_degree * d0, ...]`` when every rank has the same in-degree,
+    otherwise a list of per-rank arrays (ragged)."""
+    return synchronize(neighbor_allgather_nonblocking(
+        tensor, src_ranks=src_ranks, dst_ranks=dst_ranks,
+        enable_topo_check=enable_topo_check, name=name))
+
+
+def neighbor_allgather_nonblocking(tensor, *, src_ranks=None, dst_ranks=None,
+                                   enable_topo_check: bool = True,
+                                   name: Optional[str] = None) -> int:
+    ctx = get_context()
+    n = ctx.size()
+    if (src_ranks is None) != (dst_ranks is None):
+        raise ValueError(
+            "Arguments src_ranks and dst_ranks should be presented at the "
+            "same time")
+    if src_ranks is None:
+        spec = ctx.topology_spec()
+        in_lists = {r: ctx.in_neighbor_ranks(r) for r in range(n)}
+    else:
+        from bluefog_tpu.context import WeightArg
+        src_per = WeightArg.per_rank(src_ranks, n, "src")
+        dst_per = WeightArg.per_rank(dst_ranks, n, "dst")
+        edge_weights = {}
+        in_lists = {r: [] for r in range(n)}
+        for dstr in range(n):
+            entry = src_per[dstr] or []
+            srcs = list(entry.keys()) if isinstance(entry, dict) else list(entry)
+            for s in srcs:
+                edge_weights[(int(s), dstr)] = 1.0
+                in_lists[dstr].append(int(s))
+            in_lists[dstr].sort()
+        # cross-check like enable_topo_check
+        if enable_topo_check:
+            for srcr in range(n):
+                entry = dst_per[srcr] or []
+                dsts = list(entry.keys()) if isinstance(entry, dict) else list(entry)
+                for d in dsts:
+                    if (srcr, int(d)) not in edge_weights:
+                        raise BluefogError(
+                            "Send and recv neighbors mismatch in "
+                            "neighbor_allgather dynamic mode.")
+        from bluefog_tpu.topology.spec import DynamicTopology
+        spec = DynamicTopology.from_edges(n, edge_weights)
+    dense = ctx.run_op(("neighbor_allgather", spec.digest()),
+                       lambda x: C.neighbor_allgather(x, spec, AXIS), tensor)
+    # dense: [n(dst), n(src), d0, ...] -> ragged concat by sorted src
+    degs = {r: len(in_lists[r]) for r in in_lists}
+    uniform = len(set(degs.values())) == 1
+
+    def finalize(dense_arr):
+        from bluefog_tpu.context import host_fetch
+        host = host_fetch(dense_arr)
+        per_rank = [
+            np.concatenate([host[r, s] for s in in_lists[r]], axis=0)
+            if in_lists[r] else host[r, :0].reshape((0,) + host.shape[3:])
+            for r in range(n)
+        ]
+        if uniform:
+            return ctx.rank_sharded(np.stack(per_rank))
+        return per_rank
+
+    out = _LazyResult(dense, finalize)
+    return ctx.register_handle(name, "neighbor_allgather", out)
+
+
+class _LazyResult:
+    """Defers host-side post-processing until synchronize()."""
+
+    def __init__(self, raw, finalize):
+        self.raw = raw
+        self.finalize = finalize
+
+    def block(self):
+        jax.block_until_ready(self.raw)
+        return self.finalize(self.raw)
+
+
+def pair_gossip(tensor, target_rank, self_weight: Optional[float] = None,
+                pair_weight: Optional[float] = None,
+                name: Optional[str] = None) -> jax.Array:
+    return synchronize(pair_gossip_nonblocking(
+        tensor, target_rank, self_weight, pair_weight, name))
+
+
+def pair_gossip_nonblocking(tensor, target_rank,
+                            self_weight: Optional[float] = None,
+                            pair_weight: Optional[float] = None,
+                            name: Optional[str] = None) -> int:
+    """``target_rank``: length-``size`` sequence, entry r = rank r's pair
+    (reference per-rank scalar arg, torch/mpi_ops.py:883-945)."""
+    ctx = get_context()
+    targets = tuple(int(t) for t in target_rank)
+    if len(targets) != ctx.size():
+        raise ValueError(
+            f"target_rank must list every rank's pair (length {ctx.size()})")
+    out = ctx.run_op(
+        ("pair_gossip", targets, self_weight, pair_weight),
+        lambda x: C.pair_gossip(x, targets, AXIS, self_weight, pair_weight),
+        tensor)
+    return ctx.register_handle(name, "pair_gossip", out)
+
+
+def barrier():
+    get_context().barrier()
+
+
+def synchronize(handle: int):
+    value = get_context().synchronize(handle)
+    if isinstance(value, _LazyResult):
+        return value.block()
+    return value
+
+
+def wait(handle: int):
+    return synchronize(handle)
+
+
+def poll(handle: int) -> bool:
+    return get_context().poll(handle)
+
+
+# ------------------------------------------------------------------ #
+# windows (reference mpi_ops.py:1014-1503)
+# ------------------------------------------------------------------ #
+def win_create(tensor, name: str, zero_init: bool = False) -> bool:
+    return _wm().create(tensor, name, zero_init)
+
+
+def win_free(name: Optional[str] = None) -> bool:
+    return _wm().free(name)
+
+
+def win_update(name: str, self_weight: Optional[float] = None,
+               neighbor_weights: Optional[Dict[int, float]] = None,
+               reset: bool = False, clone: bool = False,
+               require_mutex: bool = False) -> jax.Array:
+    return _wm().update(name, self_weight, neighbor_weights, reset, clone,
+                        require_mutex)
+
+
+def win_update_then_collect(name: str, require_mutex: bool = True) -> jax.Array:
+    ctx = get_context()
+    n = ctx.size()
+    neighbor_weights = [
+        {r: 1.0 for r in ctx.in_neighbor_ranks(dst)} for dst in range(n)
+    ]
+    return win_update(name, self_weight=1.0,
+                      neighbor_weights=neighbor_weights, reset=True,
+                      require_mutex=require_mutex)
+
+
+def win_put_nonblocking(tensor, name: str, self_weight: Optional[float] = None,
+                        dst_weights=None, require_mutex: bool = False) -> int:
+    return _wm().put(tensor, name, self_weight, dst_weights, require_mutex,
+                     accumulate=False)
+
+
+def win_put(tensor, name: str, self_weight: Optional[float] = None,
+            dst_weights=None, require_mutex: bool = False) -> bool:
+    return win_wait(win_put_nonblocking(tensor, name, self_weight,
+                                        dst_weights, require_mutex))
+
+
+def win_accumulate_nonblocking(tensor, name: str,
+                               self_weight: Optional[float] = None,
+                               dst_weights=None,
+                               require_mutex: bool = False) -> int:
+    return _wm().put(tensor, name, self_weight, dst_weights, require_mutex,
+                     accumulate=True)
+
+
+def win_accumulate(tensor, name: str, self_weight: Optional[float] = None,
+                   dst_weights=None, require_mutex: bool = False) -> bool:
+    return win_wait(win_accumulate_nonblocking(tensor, name, self_weight,
+                                               dst_weights, require_mutex))
+
+
+def win_get_nonblocking(name: str, src_weights=None,
+                        require_mutex: bool = False) -> int:
+    return _wm().get(name, src_weights, require_mutex)
+
+
+def win_get(name: str, src_weights=None, require_mutex: bool = False) -> bool:
+    return win_wait(win_get_nonblocking(name, src_weights, require_mutex))
+
+
+def win_wait(handle: int) -> bool:
+    return _wm().wait(handle)
+
+
+def win_poll(handle: int) -> bool:
+    return _wm().poll(handle)
+
+
+@contextmanager
+def win_mutex(name: str, for_self: bool = False,
+              ranks: Optional[List[int]] = None):
+    with win_mutex_ctx(_wm(), name, for_self, ranks):
+        yield
+
+
+@contextmanager
+def win_lock(name: str):
+    with win_lock_ctx(_wm(), name):
+        yield
+
+
+def win_unlock(name: str):
+    _wm().window(name)  # validate; epochs are implicit under SPMD
+
+
+def win_fence(name: str):
+    _wm().window(name)
+    jax.block_until_ready(_wm().window(name).mailbox)
+
+
+def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:
+    return _wm().versions_of(name, rank)
+
+
+def get_current_created_window_names() -> List[str]:
+    return _wm().names()
+
+
+def win_associated_p(name: str, rank: Optional[int] = None) -> float:
+    return _wm().associated_p(name, rank)
+
+
+def turn_on_win_ops_with_associated_p():
+    get_context().win_ops_with_associated_p = True
+
+
+def turn_off_win_ops_with_associated_p():
+    get_context().win_ops_with_associated_p = False
+
+
+# ------------------------------------------------------------------ #
+# timeline (reference basics.py:456-546)
+# ------------------------------------------------------------------ #
+def timeline_start_activity(tensor_name: str, activity_name: str) -> bool:
+    tl = timeline_mod.get_timeline()
+    if tl is None:
+        return False
+    tl.start_activity(tensor_name, activity_name)
+    return True
+
+
+def timeline_end_activity(tensor_name: str) -> bool:
+    tl = timeline_mod.get_timeline()
+    if tl is None:
+        return False
+    tl.end_activity(tensor_name)
+    return True
+
+
+@contextmanager
+def timeline_context(tensor_name: str, activity_name: str):
+    timeline_start_activity(tensor_name, activity_name)
+    try:
+        yield
+    finally:
+        timeline_end_activity(tensor_name)

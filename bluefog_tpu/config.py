@@ -1,0 +1,53 @@
+"""Environment-variable configuration.
+
+The reference configures everything through ``BLUEFOG_*`` env vars
+(reference: docs/env_variable.rst; operations.cc:42-47).  We honor the same
+names where they still mean something on TPU, and document the ones that are
+obsolete by construction (fusion/cycle/negotiation are XLA's job now).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "log_level",
+    "log_hide_time",
+    "timeline_path",
+    "skip_negotiate_default",
+    "ops_on_cpu",
+]
+
+
+def _env(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def log_level() -> str:
+    """BLUEFOG_LOG_LEVEL: trace|debug|info|warn|error|fatal (reference
+    logging.h:75, docs/env_variable.rst:9-16)."""
+    return _env("BLUEFOG_LOG_LEVEL", "warn").lower()
+
+
+def log_hide_time() -> bool:
+    """BLUEFOG_LOG_HIDE_TIME (reference logging.h:76)."""
+    return _env("BLUEFOG_LOG_HIDE_TIME", "0") in ("1", "true", "True")
+
+
+def timeline_path() -> str:
+    """BLUEFOG_TIMELINE: path prefix for per-process Chrome-trace files
+    (reference operations.cc:464-473)."""
+    return _env("BLUEFOG_TIMELINE", "")
+
+
+def skip_negotiate_default() -> bool:
+    """BLUEFOG_SKIP_NEGOTIATE_STAGE — negotiation does not exist on TPU;
+    the flag is kept so scripts that set it keep working
+    (reference operations.cc:1149-1183)."""
+    return _env("BLUEFOG_SKIP_NEGOTIATE_STAGE", "0") in ("1", "true", "True")
+
+
+def ops_on_cpu() -> bool:
+    """BLUEFOG_OPS_ON_CPU — run collectives on the host CPU backend instead
+    of the accelerator (reference torch/mpi_ops.cc:48-50)."""
+    return _env("BLUEFOG_OPS_ON_CPU", "0") in ("1", "true", "True")

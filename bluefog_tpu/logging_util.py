@@ -1,0 +1,41 @@
+"""Leveled logging for bluefog_tpu.
+
+Reference parity: the C++ ``BFLOG`` macros (bluefog/common/logging.h:54-73)
+and the Python logger "bluefog" (bluefog/common/basics.py:27-34).  Level
+comes from ``BLUEFOG_LOG_LEVEL`` with the same names.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from bluefog_tpu import config as bfconfig
+
+_LEVELS = {
+    "trace": logging.DEBUG,  # python logging has no TRACE; map to DEBUG
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+_logger = None
+
+
+def get_logger() -> logging.Logger:
+    global _logger
+    if _logger is None:
+        logger = logging.getLogger("bluefog_tpu")
+        logger.setLevel(_LEVELS.get(bfconfig.log_level(), logging.WARNING))
+        handler = logging.StreamHandler(sys.stderr)
+        fmt = "[%(levelname)s] %(name)s: %(message)s"
+        if not bfconfig.log_hide_time():
+            fmt = "%(asctime)s " + fmt
+        handler.setFormatter(logging.Formatter(fmt))
+        logger.addHandler(handler)
+        logger.propagate = False
+        _logger = logger
+    return _logger

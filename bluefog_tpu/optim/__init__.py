@@ -1,0 +1,19 @@
+"""Distributed optimizer wrappers (optax-based).
+
+Reference parity: bluefog/torch/optimizers.py — five mechanisms:
+gradient allreduce, adapt-with-combine (CTA), adapt-then-combine (ATC),
+win-put/pull-get (async gossip), push-sum.
+"""
+
+from bluefog_tpu.optim.wrappers import (  # noqa: F401
+    CommunicationType,
+    DistributedGradientAllreduceOptimizer,
+    DistributedAdaptWithCombineOptimizer,
+    DistributedAdaptThenCombineOptimizer,
+    DistributedAllreduceOptimizer,
+    DistributedNeighborAllreduceOptimizer,
+    DistributedHierarchicalNeighborAllreduceOptimizer,
+    DistributedWinPutOptimizer,
+    DistributedPullGetOptimizer,
+    DistributedPushSumOptimizer,
+)

@@ -1,0 +1,375 @@
+"""Distributed optimizer wrappers over optax.
+
+Reference parity: bluefog/torch/optimizers.py — the five mechanisms:
+
+=====================================  =======================================
+reference (torch.optim subclasses)      this build (optax wrappers)
+=====================================  =======================================
+_DistributedOptimizer (:166)            DistributedGradientAllreduceOptimizer
+_DistributedReduceOptimizer (:297)      DistributedAdaptWithCombineOptimizer
+  (CTA: combine params, then adapt)       (+ deprecated per-comm-type aliases)
+_DistributedAdaptThenCombine (:485)     DistributedAdaptThenCombineOptimizer
+_DistributedWinOptimizer (:844)         DistributedWinPutOptimizer /
+  (win_put push / win_get pull)           DistributedPullGetOptimizer
+_DistributedPushSumOptimizer (:1026)    DistributedPushSumOptimizer
+=====================================  =======================================
+
+The reference launches communication from forward/backward *hooks* to overlap
+with compute, then waits in ``optimizer.step()``.  These wrappers expose a
+host-driven ``step(params, grads, state)`` API: each collective is dispatched
+nonblocking per parameter leaf and synchronized once at the end of the step,
+so JAX async dispatch provides the overlap the reference gets from its
+background thread.  ``step`` itself must NOT be wrapped in ``jax.jit`` — it
+re-reads host-side knobs (dynamic weights, communication cadence) every call.
+For a fully-jitted train step, inline the shard-level kernels from
+``bluefog_tpu.parallel.collectives`` (see ``bluefog_tpu.optim.functional``).
+
+Dynamic-topology knobs: ``opt.self_weight / opt.src_weights / opt.dst_weights``
+are mutable attributes re-read every step (reference optimizers.py:326-331),
+so per-iteration one-peer schedules work the same way as the reference's
+``dynamic_topology_update`` pattern (examples/pytorch_resnet.py:333-372).
+
+``num_steps_per_communication`` implements local-SGD-style periodic
+communication (reference optimizers.py:343-348).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from bluefog_tpu import api
+from bluefog_tpu.context import get_context
+
+__all__ = [
+    "CommunicationType",
+    "DistributedGradientAllreduceOptimizer",
+    "DistributedAdaptWithCombineOptimizer",
+    "DistributedAdaptThenCombineOptimizer",
+    "DistributedAllreduceOptimizer",
+    "DistributedNeighborAllreduceOptimizer",
+    "DistributedHierarchicalNeighborAllreduceOptimizer",
+    "DistributedWinPutOptimizer",
+    "DistributedPullGetOptimizer",
+    "DistributedPushSumOptimizer",
+]
+
+
+class CommunicationType(enum.Enum):
+    """Reference optimizers.py:28-35."""
+
+    neighbor_allreduce = "neighbor.allreduce"
+    hierarchical_neighbor_allreduce = "hierarchical.neighbor.allreduce"
+    allreduce = "allreduce"
+    empty = "empty"
+
+
+class _OptState(NamedTuple):
+    base: Any
+    step: jnp.ndarray  # scalar int32
+
+
+def _tree_names(params) -> Dict[str, Any]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    return {jax.tree_util.keystr(path): leaf for path, leaf in flat}
+
+
+class _DistributedOptimizerBase:
+    """Shared machinery: base optax transform + comm cadence + weight knobs."""
+
+    def __init__(self, base_optimizer: optax.GradientTransformation,
+                 num_steps_per_communication: int = 1):
+        self.base = base_optimizer
+        self.num_steps_per_communication = int(num_steps_per_communication)
+        # Mutable dynamic-topology knobs (reference optimizers.py:326-331).
+        self.self_weight = None
+        self.src_weights = None
+        self.dst_weights = None
+        self._step_count = 0
+
+    def init(self, params) -> _OptState:
+        return _OptState(base=self.base.init(params), step=jnp.zeros((), jnp.int32))
+
+    def _should_communicate(self) -> bool:
+        self._step_count += 1
+        return self._step_count % self.num_steps_per_communication == 0
+
+    def _base_apply(self, params, grads, state: _OptState):
+        updates, new_base = self.base.update(grads, state.base, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, _OptState(base=new_base, step=state.step + 1)
+
+    # communication helpers ------------------------------------------------
+    def _pipelined(self, params, launch: Callable) -> Any:
+        """Dispatch ``launch(leaf) -> handle`` for every leaf, then
+        synchronize once — all collectives are enqueued before the first
+        host wait (the reference gets this overlap from its hooks +
+        background thread; here JAX async dispatch provides it)."""
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        handles = [launch(leaf) for leaf in leaves]
+        outs = [api.synchronize(h) for h in handles]
+        return jax.tree_util.tree_unflatten(treedef, outs)
+
+    def _combine(self, params):
+        return self._pipelined(
+            params,
+            lambda p: api.neighbor_allreduce_nonblocking(
+                p, self_weight=self.self_weight, src_weights=self.src_weights,
+                dst_weights=self.dst_weights, enable_topo_check=False))
+
+
+class DistributedGradientAllreduceOptimizer(_DistributedOptimizerBase):
+    """Horovod-style synchronous gradient averaging (reference
+    optimizers.py:166-294, factory :1376-1423)."""
+
+    def step(self, params, grads, state: _OptState):
+        if self._should_communicate():
+            grads = self._pipelined(
+                grads, lambda g: api.allreduce_nonblocking(g, average=True))
+        return self._base_apply(params, grads, state)
+
+
+class DistributedAdaptWithCombineOptimizer(_DistributedOptimizerBase):
+    """CTA — combine-then-adapt: neighbor-average the *parameters*, then take
+    the base optimizer step with the local gradients (reference
+    _DistributedReduceOptimizer optimizers.py:297-482, factory :1497-1554)."""
+
+    def __init__(self, base_optimizer, communication_type=CommunicationType.neighbor_allreduce,
+                 num_steps_per_communication: int = 1):
+        super().__init__(base_optimizer, num_steps_per_communication)
+        self.communication_type = communication_type
+
+    def _communicate(self, params):
+        ct = self.communication_type
+        if ct == CommunicationType.empty:
+            return params
+        if ct == CommunicationType.allreduce:
+            return self._pipelined(
+                params, lambda p: api.allreduce_nonblocking(p, average=True))
+        if ct == CommunicationType.hierarchical_neighbor_allreduce:
+            return self._pipelined(
+                params,
+                lambda p: api.hierarchical_neighbor_allreduce_nonblocking(
+                    p, self_weight=self.self_weight,
+                    src_machine_weights=self.src_weights,
+                    dst_machine_weights=self.dst_weights))
+        return self._combine(params)
+
+    def step(self, params, grads, state: _OptState):
+        if self._should_communicate():
+            params = self._communicate(params)
+        return self._base_apply(params, grads, state)
+
+
+class DistributedAdaptThenCombineOptimizer(DistributedAdaptWithCombineOptimizer):
+    """ATC — adapt-then-combine: take the base step first, then
+    neighbor-average the updated parameters (reference
+    _DistributedAdaptThenCombineOptimizer optimizers.py:485-841,
+    factory :1426-1494)."""
+
+    def step(self, params, grads, state: _OptState):
+        params, state = self._base_apply(params, grads, state)
+        if self._should_communicate():
+            params = self._communicate(params)
+        return params, state
+
+
+# Deprecated aliases (reference optimizers.py:1301-1373) -------------------
+def DistributedAllreduceOptimizer(base_optimizer,
+                                  num_steps_per_communication: int = 1):
+    return DistributedAdaptWithCombineOptimizer(
+        base_optimizer, CommunicationType.allreduce,
+        num_steps_per_communication)
+
+
+def DistributedNeighborAllreduceOptimizer(base_optimizer,
+                                          num_steps_per_communication: int = 1):
+    return DistributedAdaptWithCombineOptimizer(
+        base_optimizer, CommunicationType.neighbor_allreduce,
+        num_steps_per_communication)
+
+
+def DistributedHierarchicalNeighborAllreduceOptimizer(
+        base_optimizer, num_steps_per_communication: int = 1):
+    return DistributedAdaptWithCombineOptimizer(
+        base_optimizer, CommunicationType.hierarchical_neighbor_allreduce,
+        num_steps_per_communication)
+
+
+class _DistributedWindowOptimizerBase(_DistributedOptimizerBase):
+    """Common window lifecycle for the async-gossip optimizers."""
+
+    def __init__(self, base_optimizer, num_steps_per_communication: int = 1,
+                 window_prefix: Optional[str] = None):
+        super().__init__(base_optimizer, num_steps_per_communication)
+        self.window_prefix = (window_prefix + ".") if window_prefix else ""
+        self.force_barrier = False
+        self._registered = False
+        self._names: Dict[str, Any] = {}
+
+    def _window_name(self, key: str) -> str:
+        return f"{self.window_prefix}param{key}"
+
+    def register_windows(self, params, zero_init: bool = False):
+        """win_create per parameter leaf (reference optimizers.py:933-944)."""
+        for key, leaf in _tree_names(params).items():
+            name = self._window_name(key)
+            if not api.win_create(leaf, name, zero_init=zero_init):
+                raise ValueError(f"Cannot allocate window for parameter {name}")
+            self._names[key] = name
+        self._registered = True
+
+    def unregister_windows(self):
+        for name in self._names.values():
+            if name in api.get_current_created_window_names():
+                api.win_free(name)
+        self._names.clear()
+        self._registered = False
+
+    def init(self, params) -> _OptState:
+        if not self._registered and get_context().size() > 1:
+            self.register_windows(params, zero_init=self._zero_init())
+        return super().init(params)
+
+    def _zero_init(self) -> bool:
+        return False
+
+
+class DistributedWinPutOptimizer(_DistributedWindowOptimizerBase):
+    """Asynchronous push gossip: win_put parameters to out-neighbors, combine
+    with win_update, then take the base step (reference
+    _DistributedWinOptimizer push style, optimizers.py:844-1023,
+    factory :1271-1298)."""
+
+    def step(self, params, grads, state: _OptState):
+        if self.force_barrier:
+            api.barrier()
+        if get_context().size() > 1 and self._should_communicate():
+            flat = _tree_names(params)
+            handles = {}
+            for key, leaf in flat.items():
+                handles[key] = api.win_put_nonblocking(
+                    leaf, self._names[key], dst_weights=self.dst_weights,
+                    require_mutex=False)
+            new_flat = {}
+            for key in flat:
+                api.win_wait(handles[key])
+                new_flat[key] = api.win_update(self._names[key],
+                                               require_mutex=True)
+            params = _rebuild(params, new_flat)
+        return self._base_apply(params, grads, state)
+
+
+class DistributedPullGetOptimizer(_DistributedWindowOptimizerBase):
+    """Asynchronous pull gossip: win_get from in-neighbors then combine
+    (reference pull style, optimizers.py:844-1023, factory :1225-1268)."""
+
+    def step(self, params, grads, state: _OptState):
+        if self.force_barrier:
+            api.barrier()
+        if get_context().size() > 1 and self._should_communicate():
+            flat = _tree_names(params)
+            handles = {}
+            for key in flat:
+                # The window tensor must track the live parameter for
+                # neighbors' gets to see fresh values.
+                api._wm().set_value(self._names[key], flat[key])
+                handles[key] = api.win_get_nonblocking(
+                    self._names[key], src_weights=self.src_weights,
+                    require_mutex=True)
+            new_flat = {}
+            for key in flat:
+                api.win_wait(handles[key])
+                new_flat[key] = api.win_update(self._names[key],
+                                               require_mutex=True)
+            params = _rebuild(params, new_flat)
+        return self._base_apply(params, grads, state)
+
+
+class DistributedPushSumOptimizer(_DistributedWindowOptimizerBase):
+    """Push-sum / gradient-push for directed graphs (reference
+    _DistributedPushSumOptimizer optimizers.py:1026-1177, factory :1180-1222).
+
+    Windows hold the extended payload [flatten(param) ‖ ps_weight]
+    (ps_weight init 1).  Each communication:
+      1. win_accumulate(extended * a) into out-neighbors, a = 1/(outdeg+1)
+         — the same scale applied to self via ``self_weight``
+      2. win_update_then_collect: extended += sum(mailbox); reset mailbox
+      3. de-bias: param = x / ps_weight.
+    The invariant sum_i ps_weight_i == size is what the reference's
+    associated-P tests assert (test/torch_win_ops_test.py:780-863).
+    """
+
+    def __init__(self, base_optimizer, num_steps_per_communication: int = 1,
+                 window_prefix: Optional[str] = None):
+        super().__init__(base_optimizer, num_steps_per_communication,
+                         window_prefix)
+        self.force_barrier = True
+        ctx = get_context()
+        self._outdeg = {
+            r: len(ctx.out_neighbor_ranks(r)) for r in range(ctx.size())
+        }
+        # Uniform column-stochastic weights (reference optimizers.py:1031-1035)
+        self.dst_weights = [
+            {d: 1.0 / (self._outdeg[r] + 1) for d in ctx.out_neighbor_ranks(r)}
+            for r in range(ctx.size())
+        ]
+        self.self_weight = [
+            1.0 / (self._outdeg[r] + 1) for r in range(ctx.size())
+        ]
+
+    def _zero_init(self) -> bool:
+        return True
+
+    def register_windows(self, params, zero_init: bool = True):
+        ctx = get_context()
+        n = ctx.size()
+        for key, leaf in _tree_names(params).items():
+            name = self._window_name(key)
+            flatdim = int(np.prod(leaf.shape[1:]))
+            extended = jnp.concatenate(
+                [jnp.reshape(leaf, (n, flatdim)),
+                 jnp.ones((n, 1), leaf.dtype)], axis=1)
+            if not api.win_create(extended, name, zero_init=True):
+                raise ValueError(f"Cannot allocate window for parameter {name}")
+            self._names[key] = name
+        self._registered = True
+
+    def step(self, params, grads, state: _OptState):
+        if self.force_barrier:
+            api.barrier()
+        ctx = get_context()
+        if ctx.size() > 1 and self._should_communicate():
+            n = ctx.size()
+            flat = _tree_names(params)
+            new_flat = {}
+            for key, leaf in flat.items():
+                name = self._names[key]
+                win = api._wm().window(name)
+                # current extended payload: fresh param + current ps weight
+                ps = win.value[:, -1:]
+                flatdim = int(np.prod(leaf.shape[1:]))
+                extended = jnp.concatenate(
+                    [jnp.reshape(leaf, (n, flatdim)).astype(win.dtype), ps],
+                    axis=1)
+                api._wm().set_value(name, extended)
+                h = api.win_accumulate_nonblocking(
+                    extended, name, self_weight=self.self_weight,
+                    dst_weights=self.dst_weights, require_mutex=True)
+                api.win_wait(h)
+                collected = api.win_update_then_collect(name)
+                corrected = collected[:, :-1] / collected[:, -1:]
+                new_flat[key] = jnp.reshape(corrected, leaf.shape).astype(leaf.dtype)
+            params = _rebuild(params, new_flat)
+        return self._base_apply(params, grads, state)
+
+
+def _rebuild(params, new_flat: Dict[str, Any]):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    leaves = [new_flat[jax.tree_util.keystr(path)] for path, _ in flat]
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,3 @@
+"""TPU parallelism layer: collective kernels, meshes, sequence parallelism."""
+
+from bluefog_tpu.parallel import collectives  # noqa: F401

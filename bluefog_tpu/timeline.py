@@ -1,0 +1,134 @@
+"""Chrome-tracing timeline.
+
+Reference parity: the C++ ``Timeline``/``TimelineWriter`` pair
+(bluefog/common/timeline.{h,cc}) which streams per-op activity spans to
+``$BLUEFOG_TIMELINE<rank>.json`` via a dedicated writer thread.  Here the
+heavyweight path (device execution) is already traced by ``jax.profiler``;
+this module records the *framework-level* activity spans (enqueue, compute,
+update phases) with the same file format so the reference's timeline
+tooling (chrome://tracing) works unchanged.
+
+Events are handed to a background writer thread over a queue, like the
+reference's lock-free SPSC design (timeline.h:65-67) — the Python GIL makes
+a queue.Queue equivalent.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import queue
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+__all__ = ["Timeline", "get_timeline", "start_timeline", "stop_timeline"]
+
+
+class Timeline:
+    def __init__(self, path: str, rank: int = 0):
+        self.path = f"{path}{rank}.json"
+        self.rank = rank
+        self._t0 = time.perf_counter()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._file = open(self.path, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._writer, daemon=True)
+        self._thread.start()
+        self._open_spans = {}
+        atexit.register(self.close)
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _writer(self):
+        while not self._stop.is_set() or not self._queue.empty():
+            try:
+                event = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if not self._first:
+                self._file.write(",\n")
+            self._first = False
+            self._file.write(json.dumps(event))
+            self._file.flush()
+
+    def start_activity(self, tensor_name: str, activity: str):
+        self._open_spans.setdefault(tensor_name, []).append(activity)
+        self._queue.put({
+            "name": activity,
+            "cat": tensor_name,
+            "ph": "B",
+            "ts": self._now_us(),
+            "pid": self.rank,
+            "tid": tensor_name,
+        })
+
+    def end_activity(self, tensor_name: str):
+        spans = self._open_spans.get(tensor_name)
+        if spans:
+            spans.pop()
+        self._queue.put({
+            "ph": "E",
+            "ts": self._now_us(),
+            "pid": self.rank,
+            "tid": tensor_name,
+        })
+
+    def instant(self, name: str):
+        self._queue.put({
+            "name": name,
+            "ph": "i",
+            "ts": self._now_us(),
+            "pid": self.rank,
+            "s": "p",
+        })
+
+    def activity(self, name: str):
+        """One-shot marker used by the eager op layer."""
+        self.instant(name)
+
+    @contextmanager
+    def context(self, tensor_name: str, activity: str):
+        self.start_activity(tensor_name, activity)
+        try:
+            yield
+        finally:
+            self.end_activity(tensor_name)
+
+    def close(self):
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        try:
+            self._file.write("\n]\n")
+            self._file.close()
+        except ValueError:
+            pass
+
+
+_timeline: Optional[Timeline] = None
+
+
+def get_timeline() -> Optional[Timeline]:
+    return _timeline
+
+
+def start_timeline(path: str, rank: int = 0) -> Timeline:
+    global _timeline
+    if _timeline is not None:
+        _timeline.close()
+    _timeline = Timeline(path, rank)
+    return _timeline
+
+
+def stop_timeline():
+    global _timeline
+    if _timeline is not None:
+        _timeline.close()
+        _timeline = None

@@ -1,0 +1,41 @@
+"""Virtual topology library: static graph generators, weights, dynamic
+schedules, and the device-ready ``Topology`` spec.
+
+Reference parity: bluefog/common/topology_util.py (plus
+bluefog/torch/topology_util.py helpers, re-exported from
+``bluefog_tpu.topology.infer``).
+"""
+
+from bluefog_tpu.topology.graphs import (  # noqa: F401
+    ExponentialTwoGraph,
+    ExponentialGraph,
+    SymmetricExponentialGraph,
+    MeshGrid2DGraph,
+    StarGraph,
+    RingGraph,
+    FullyConnectedGraph,
+    IsTopologyEquivalent,
+    IsRegularGraph,
+    GetRecvWeights,
+    GetSendWeights,
+    circulant_graph,
+)
+from bluefog_tpu.topology.dynamic import (  # noqa: F401
+    GetDynamicOnePeerSendRecvRanks,
+    GetExp2DynamicSendRecvMachineRanks,
+    GetInnerOuterRingDynamicSendRecvRanks,
+    GetInnerOuterExpo2DynamicSendRecvRanks,
+    one_peer_round,
+    inner_outer_ring_round,
+    inner_outer_expo2_round,
+    exp2_machine_round,
+)
+from bluefog_tpu.topology.spec import (  # noqa: F401
+    Topology,
+    DynamicTopology,
+    ShiftClass,
+)
+from bluefog_tpu.topology.infer import (  # noqa: F401
+    InferSourceFromDestinationRanks,
+    InferDestinationFromSourceRanks,
+)

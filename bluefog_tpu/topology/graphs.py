@@ -1,0 +1,231 @@
+"""Static virtual-topology generators.
+
+Behavioral parity with the reference's graph library
+(reference: bluefog/common/topology_util.py:66-313).  All generators return a
+weighted ``networkx.DiGraph`` whose adjacency matrix W satisfies the
+averaging convention used throughout the framework:
+
+    new_x[rank] = W[rank, rank] * x[rank] + sum_{src} W[src, rank] * x[src]
+
+i.e. **W[i, j] is the weight node j applies to the value it receives from
+node i** (column-stochastic combination; reference GetRecvWeights,
+topology_util.py:40-51).
+
+Most graphs here are circulant: node ``i`` connects to ``(i + s) % n`` for a
+fixed set of shifts ``s``.  Circulant topologies are exactly the ones that
+lower to a single ``lax.ppermute`` per shift on TPU, so we build them from an
+explicit shift->weight profile and keep that profile around (as graph
+metadata) for the collective controller.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+import networkx as nx
+import numpy as np
+
+__all__ = [
+    "ExponentialTwoGraph",
+    "ExponentialGraph",
+    "SymmetricExponentialGraph",
+    "MeshGrid2DGraph",
+    "StarGraph",
+    "RingGraph",
+    "FullyConnectedGraph",
+    "IsTopologyEquivalent",
+    "IsRegularGraph",
+    "GetRecvWeights",
+    "GetSendWeights",
+    "circulant_graph",
+]
+
+
+def _is_power_of(x: int, base: int) -> bool:
+    if x <= 0:
+        return False
+    p = 1
+    while p < x:
+        p *= base
+    return p == x
+
+
+def circulant_graph(size: int, shift_weights: Dict[int, float]) -> nx.DiGraph:
+    """Build a circulant digraph from a ``{shift: weight}`` profile.
+
+    Edge (i, (i+s) % size) gets weight ``shift_weights[s]`` for every node i.
+    Shift 0 is the self-loop weight.
+    """
+    row = np.zeros(size)
+    for shift, w in shift_weights.items():
+        row[shift % size] += w
+    adjacency = np.stack([np.roll(row, i) for i in range(size)])
+    graph = nx.from_numpy_array(adjacency, create_using=nx.DiGraph)
+    return graph
+
+
+def _uniform_circulant(size: int, shifts) -> nx.DiGraph:
+    """Circulant graph with uniform weight 1/(len(shifts)) over given shifts
+    (which include the self shift 0)."""
+    shifts = sorted(set(int(s) % size for s in shifts))
+    w = 1.0 / len(shifts)
+    return circulant_graph(size, {s: w for s in shifts})
+
+
+def ExponentialTwoGraph(size: int) -> nx.DiGraph:
+    """Each node i connects to i + 2**k for all 2**k < size.
+
+    Parity: reference topology_util.py:66-89 (selects indices whose value is
+    a power of two via the ``i & (i-1) == 0`` trick, including index 1==2**0
+    and index 0 as self).
+    """
+    assert size > 0
+    shifts = [0] + [s for s in range(1, size) if s & (s - 1) == 0]
+    return _uniform_circulant(size, shifts)
+
+
+def ExponentialGraph(size: int, base: int = 2) -> nx.DiGraph:
+    """Each node i connects to i + base**k (all powers of ``base`` < size).
+
+    Parity: reference topology_util.py:99-125.
+    """
+    assert size > 0
+    shifts = [0] + [s for s in range(1, size) if _is_power_of(s, base)]
+    return _uniform_circulant(size, shifts)
+
+
+def SymmetricExponentialGraph(size: int, base: int = 4) -> nx.DiGraph:
+    """Exponential graph whose second half of shifts mirrors the first half.
+
+    Parity: reference topology_util.py:128-157 (shift s counts if
+    min(s, size - s) is a power of ``base``... precisely: index = s when
+    s <= size//2 else size - s).
+    """
+    assert size > 0
+    shifts = [0]
+    for s in range(1, size):
+        index = s if s <= size // 2 else size - s
+        if _is_power_of(index, base):
+            shifts.append(s)
+    return _uniform_circulant(size, shifts)
+
+
+def MeshGrid2DGraph(size: int, shape: Optional[Tuple[int, int]] = None) -> nx.DiGraph:
+    """2-D grid graph with Metropolis-Hastings weights.
+
+    Parity: reference topology_util.py:160-211 — 4-neighborhood grid with
+    Hastings-rule weights w_ij = 1/max(deg_i, deg_j) (degree counts self),
+    self weight = 2 - row sum.
+    """
+    assert size > 0
+    if shape is None:
+        nrow = int(np.sqrt(size))
+        while size % nrow != 0:
+            nrow -= 1
+        shape = (nrow, size // nrow)
+    nrow, ncol = shape
+    assert nrow * ncol == size, "The shape doesn't match the size provided."
+
+    connect = np.zeros((size, size))
+    for i in range(size):
+        connect[i, i] = 1.0
+        if (i + 1) % ncol != 0:  # right neighbor in the same row
+            connect[i, i + 1] = connect[i + 1, i] = 1.0
+        if i + ncol < size:  # neighbor in the next row
+            connect[i, i + ncol] = connect[i + ncol, i] = 1.0
+
+    degree = [np.count_nonzero(connect[i]) for i in range(size)]  # incl. self
+    weights = np.zeros((size, size))
+    for i in range(size):
+        for j in np.nonzero(connect[i])[0]:
+            if i != j:
+                weights[i, j] = 1.0 / max(degree[i], degree[j])
+        weights[i, i] = 1.0 - weights[i].sum()
+    return nx.from_numpy_array(weights, create_using=nx.DiGraph)
+
+
+def StarGraph(size: int, center_rank: int = 0) -> nx.DiGraph:
+    """Bidirectional star. Parity: reference topology_util.py:214-237."""
+    assert size > 0
+    weights = np.zeros((size, size))
+    for i in range(size):
+        weights[i, i] = 1.0 - 1.0 / size
+        weights[center_rank, i] = 1.0 / size
+        weights[i, center_rank] = 1.0 / size
+    return nx.from_numpy_array(weights, create_using=nx.DiGraph)
+
+
+def RingGraph(size: int, connect_style: int = 0) -> nx.DiGraph:
+    """Ring graph. connect_style: 0 = bidirectional, 1 = left only,
+    2 = right only. Parity: reference topology_util.py:240-281."""
+    assert size > 0
+    assert 0 <= connect_style <= 2, (
+        "connect_style has to be int between 0 and 2, where 0 for "
+        "bi-connection, 1 for left connection, 2 for right connection."
+    )
+    if size == 1:
+        return circulant_graph(1, {0: 1.0})
+    if size == 2:
+        return circulant_graph(2, {0: 0.5, 1: 0.5})
+    if connect_style == 0:
+        return circulant_graph(size, {0: 1 / 3, 1: 1 / 3, size - 1: 1 / 3})
+    if connect_style == 1:
+        return circulant_graph(size, {0: 0.5, size - 1: 0.5})
+    return circulant_graph(size, {0: 0.5, 1: 0.5})
+
+
+def FullyConnectedGraph(size: int) -> nx.DiGraph:
+    """All-to-all with uniform 1/size weights.
+    Parity: reference topology_util.py:284-303."""
+    assert size > 0
+    return circulant_graph(size, {s: 1.0 / size for s in range(size)})
+
+
+def IsTopologyEquivalent(topo1: Optional[nx.DiGraph], topo2: Optional[nx.DiGraph]) -> bool:
+    """Weighted-adjacency equality (not isomorphism).
+    Parity: reference topology_util.py:23-37."""
+    if topo1 is None or topo2 is None:
+        return False
+    if topo1.number_of_nodes() != topo2.number_of_nodes():
+        return False
+    if topo1.number_of_edges() != topo2.number_of_edges():
+        return False
+    a1 = nx.to_numpy_array(topo1)
+    a2 = nx.to_numpy_array(topo2)
+    return bool((a1 == a2).all())
+
+
+def IsRegularGraph(topo: nx.DiGraph) -> bool:
+    """All nodes have the same (total) degree.
+    Parity: reference topology_util.py:306-312."""
+    degree = topo.degree(0)
+    return all(topo.degree(r) == degree for r in range(1, topo.number_of_nodes()))
+
+
+def GetRecvWeights(topo: nx.DiGraph, rank: int) -> Tuple[float, Dict[int, float]]:
+    """(self_weight, {src_rank: weight}) that ``rank`` applies when combining.
+    Parity: reference topology_util.py:40-51."""
+    weights = nx.to_numpy_array(topo)
+    self_weight = 0.0
+    neighbor_weights = {}
+    for src in topo.predecessors(rank):
+        if src == rank:
+            self_weight = float(weights[rank, rank])
+        else:
+            neighbor_weights[src] = float(weights[src, rank])
+    return self_weight, neighbor_weights
+
+
+def GetSendWeights(topo: nx.DiGraph, rank: int) -> Tuple[float, Dict[int, float]]:
+    """(self_weight, {dst_rank: weight}) read along ``rank``'s out-edges.
+    Parity: reference topology_util.py:54-64."""
+    weights = nx.to_numpy_array(topo)
+    self_weight = 0.0
+    neighbor_weights = {}
+    for dst in topo.successors(rank):
+        if dst == rank:
+            self_weight = float(weights[rank, rank])
+        else:
+            neighbor_weights[dst] = float(weights[rank, dst])
+    return self_weight, neighbor_weights

@@ -1,0 +1,65 @@
+"""Model/optimizer state distribution helpers.
+
+Reference parity: bluefog/torch/utility.py (broadcast_parameters:26,
+allreduce_parameters:58, broadcast_optimizer_state:89).  Parameters are
+pytrees whose leaves are rank-major ``[size, ...]`` arrays (or plain arrays,
+which are treated as already-replicated and broadcast into rank-major form).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bluefog_tpu import api
+
+__all__ = [
+    "broadcast_parameters",
+    "allreduce_parameters",
+    "broadcast_optimizer_state",
+]
+
+
+def _leaf_broadcast(leaf, root_rank: int):
+    from bluefog_tpu.context import get_context
+
+    ctx = get_context()
+    arr = jnp.asarray(leaf)
+    if arr.ndim >= 1 and arr.shape[0] == ctx.size():
+        return api.broadcast(arr, root_rank)
+    # Replicated leaf: tile into rank-major form from root's value.
+    tiled = jnp.broadcast_to(arr[None], (ctx.size(),) + arr.shape)
+    return api.broadcast(tiled, root_rank)
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Broadcast rank ``root_rank``'s parameters to every rank.
+    Reference: torch/utility.py:26-55 (used to make initial models
+    consistent)."""
+    return jax.tree_util.tree_map(lambda p: _leaf_broadcast(p, root_rank), params)
+
+
+def allreduce_parameters(params: Any) -> Any:
+    """Average parameters across all ranks.
+    Reference: torch/utility.py:58-86."""
+    return jax.tree_util.tree_map(lambda p: api.allreduce(p, average=True), params)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
+    """Broadcast optimizer state (an optax state pytree).
+    Reference: torch/utility.py:89-216 — the reference walks torch
+    state_dicts; optax states are already pytrees so a tree_map suffices.
+    Non-array leaves (step counts etc.) pass through from root unchanged."""
+
+    def bcast(leaf):
+        if isinstance(leaf, (int, float, bool)) or leaf is None:
+            return leaf
+        try:
+            return _leaf_broadcast(leaf, root_rank)
+        except TypeError:
+            return leaf
+
+    return jax.tree_util.tree_map(bcast, opt_state)

@@ -1,0 +1,105 @@
+"""Context/introspection tests.
+
+Mirrors reference test/torch_basics_test.py (rank/size, topology set/load
+failure modes, neighbor sets per topology).
+"""
+
+import networkx as nx
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.topology import (
+    ExponentialGraph,
+    ExponentialTwoGraph,
+    IsTopologyEquivalent,
+    RingGraph,
+    StarGraph,
+)
+
+
+def test_init_size_rank(bf_ctx):
+    assert bf.size() == 8
+    assert bf.rank() == 0
+    assert bf.local_size() == 8
+    assert bf.local_rank() == 0
+    assert bf.machine_size() == 1
+    assert bf.is_homogeneous()
+    assert bf.is_initialized()
+
+
+def test_default_topology_is_exponential(bf_ctx):
+    topo = bf.load_topology()
+    assert IsTopologyEquivalent(topo, ExponentialGraph(8))
+    assert not bf.is_topo_weighted()
+
+
+def test_set_topology(bf_ctx):
+    assert bf.set_topology(RingGraph(8))
+    assert IsTopologyEquivalent(bf.load_topology(), RingGraph(8))
+    assert bf.set_topology(StarGraph(8), is_weighted=True)
+    assert bf.is_topo_weighted()
+
+
+def test_set_topology_wrong_size(bf_ctx):
+    assert not bf.set_topology(RingGraph(4))
+
+
+def test_set_topology_not_digraph(bf_ctx):
+    assert not bf.set_topology("not a graph")
+
+
+def test_set_topology_fails_with_live_window(bf_ctx):
+    """Reference torch_basics_test.py:74-106: cannot change topology while
+    windows are registered."""
+    x = np.ones((8, 4))
+    assert bf.win_create(x, "topo_pin_test")
+    assert not bf.set_topology(RingGraph(8))
+    assert bf.win_free("topo_pin_test")
+    assert bf.set_topology(RingGraph(8))
+
+
+def test_neighbor_ranks(bf_ctx):
+    bf.set_topology(ExponentialTwoGraph(8))
+    assert bf.in_neighbor_ranks(0) == [4, 6, 7]
+    assert bf.out_neighbor_ranks(0) == [1, 2, 4]
+    assert bf.in_neighbor_ranks(3) == [1, 2, 7]
+    # default rank is process rank 0
+    assert bf.in_neighbor_ranks() == [4, 6, 7]
+
+
+def test_machine_topology(bf_ctx):
+    bf.shutdown()
+    bf.init(local_size=4)
+    assert bf.machine_size() == 2
+    assert bf.local_size() == 4
+    ring2 = RingGraph(2)
+    assert bf.set_machine_topology(ring2)
+    assert IsTopologyEquivalent(bf.load_machine_topology(), ring2)
+    assert bf.in_neighbor_machine_ranks(0) == [1]
+    assert bf.out_neighbor_machine_ranks(0) == [1]
+
+
+def test_machine_topology_wrong_size(bf_ctx):
+    bf.shutdown()
+    bf.init(local_size=4)
+    assert not bf.set_machine_topology(RingGraph(8))
+
+
+def test_parity_shims(bf_ctx):
+    assert bf.mpi_threads_supported()
+    assert bf.unified_mpi_window_model_supported()
+    assert not bf.nccl_built()
+    bf.suspend()
+    bf.resume()
+    bf.set_skip_negotiate_stage(True)
+    assert bf.get_skip_negotiate_stage()
+    bf.set_skip_negotiate_stage(False)
+
+
+def test_rank_value_helpers(bf_ctx):
+    x = bf.from_rank_values(lambda r: np.full((3,), float(r)))
+    assert x.shape == (8, 3)
+    vals = bf.to_rank_values(x)
+    for r in range(8):
+        np.testing.assert_allclose(vals[r], r)

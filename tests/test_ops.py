@@ -1,0 +1,294 @@
+"""Collective op tests.
+
+Mirrors reference test/torch_ops_test.py: broadcast, allreduce, allgather,
+neighbor_allreduce (static topologies / weighted / dynamic / dst-weight),
+neighbor_allgather, pair_gossip — across dtypes, on 8 virtual devices.
+"""
+
+import jax.numpy as jnp
+import networkx as nx
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.topology import (
+    ExponentialTwoGraph,
+    FullyConnectedGraph,
+    GetRecvWeights,
+    MeshGrid2DGraph,
+    RingGraph,
+    StarGraph,
+)
+
+SIZE = 8
+DTYPES = [np.float32, np.float64, np.int32]
+
+
+def rank_tensor(shape, dtype=np.float32):
+    """Per-rank tensor filled with the rank id (reference test pattern)."""
+    return bf.from_rank_values(
+        lambda r: np.full(shape, r, dtype=dtype))
+
+
+# ------------------------------------------------------------------ #
+# allreduce / broadcast / allgather
+# ------------------------------------------------------------------ #
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_allreduce_average(bf_ctx, dtype):
+    x = rank_tensor((4, 3), dtype)
+    out = bf.allreduce(x, average=True)
+    expected = sum(range(SIZE)) / SIZE  # 3.5
+    if np.issubdtype(dtype, np.integer):
+        expected = int(expected)
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_allreduce_sum(bf_ctx):
+    x = rank_tensor((5,), np.float32)
+    out = bf.allreduce(x, average=False)
+    np.testing.assert_allclose(np.asarray(out), sum(range(SIZE)))
+
+
+def test_allreduce_nonblocking_poll(bf_ctx):
+    x = rank_tensor((4,), np.float32)
+    handle = bf.allreduce_nonblocking(x)
+    out = bf.synchronize(handle)
+    np.testing.assert_allclose(np.asarray(out), 3.5)
+
+
+def test_duplicate_inflight_names_rejected(bf_ctx):
+    x = rank_tensor((2,), np.float32)
+    h1 = bf.allreduce_nonblocking(x, name="dup")
+    with pytest.raises(Exception):
+        bf.allreduce_nonblocking(x, name="dup")
+    bf.synchronize(h1)
+    # after synchronize the name is free again
+    h2 = bf.allreduce_nonblocking(x, name="dup")
+    bf.synchronize(h2)
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(bf_ctx, root):
+    x = rank_tensor((4, 2), np.float64)
+    out = bf.broadcast(x, root_rank=root)
+    np.testing.assert_allclose(np.asarray(out), root)
+
+
+def test_allgather(bf_ctx):
+    x = rank_tensor((2, 3), np.float32)
+    out = bf.allgather(x)
+    assert out.shape == (SIZE, SIZE * 2, 3)
+    host = np.asarray(out)
+    for r in range(SIZE):
+        for s in range(SIZE):
+            np.testing.assert_allclose(host[r, 2 * s:2 * s + 2], s)
+
+
+# ------------------------------------------------------------------ #
+# neighbor_allreduce: static topologies (reference :606-798)
+# ------------------------------------------------------------------ #
+@pytest.mark.parametrize(
+    "maker", [ExponentialTwoGraph, RingGraph, MeshGrid2DGraph, StarGraph,
+              FullyConnectedGraph]
+)
+def test_neighbor_allreduce_static_uniform(bf_ctx, maker):
+    graph = maker(SIZE)
+    bf.set_topology(graph)
+    x = rank_tensor((3, 2), np.float64)
+    out = np.asarray(bf.neighbor_allreduce(x))
+    for r in range(SIZE):
+        nbrs = sorted(s for s in graph.predecessors(r) if s != r)
+        expected = (r + sum(nbrs)) / (len(nbrs) + 1)
+        np.testing.assert_allclose(out[r], expected, atol=1e-12)
+
+
+@pytest.mark.parametrize("maker", [ExponentialTwoGraph, MeshGrid2DGraph,
+                                   RingGraph])
+def test_neighbor_allreduce_static_weighted(bf_ctx, maker):
+    """Reference torch_ops_test.py:873+ (weighted topology)."""
+    graph = maker(SIZE)
+    bf.set_topology(graph, is_weighted=True)
+    x = rank_tensor((4,), np.float64)
+    out = np.asarray(bf.neighbor_allreduce(x))
+    for r in range(SIZE):
+        self_w, nbr_w = GetRecvWeights(graph, r)
+        expected = self_w * r + sum(w * s for s, w in nbr_w.items())
+        np.testing.assert_allclose(out[r], expected, atol=1e-12)
+
+
+def test_neighbor_allreduce_explicit_weights(bf_ctx):
+    """Per-rank explicit self/src weights on the static topology."""
+    bf.set_topology(RingGraph(SIZE))  # in-neighbors: r-1, r+1
+    self_weight = 0.5
+    src_weights = [
+        {(r - 1) % SIZE: 0.25, (r + 1) % SIZE: 0.25} for r in range(SIZE)
+    ]
+    x = rank_tensor((2,), np.float64)
+    out = np.asarray(bf.neighbor_allreduce(
+        x, self_weight=self_weight, src_weights=src_weights))
+    for r in range(SIZE):
+        expected = 0.5 * r + 0.25 * ((r - 1) % SIZE) + 0.25 * ((r + 1) % SIZE)
+        np.testing.assert_allclose(out[r], expected, atol=1e-12)
+
+
+def test_neighbor_allreduce_bf16_precision(bf_ctx):
+    """bf16 payloads combine in f32 (SURVEY §7 hard part 3)."""
+    bf.set_topology(FullyConnectedGraph(SIZE))
+    x = bf.from_rank_values(
+        lambda r: np.full((16,), 1.0 + r * 1e-2, dtype=np.float32))
+    x16 = jnp.asarray(x, dtype=jnp.bfloat16)
+    out = np.asarray(bf.neighbor_allreduce(bf.rank_sharded(x16)),
+                     dtype=np.float32)
+    expected = np.mean([1.0 + r * 1e-2 for r in range(SIZE)])
+    np.testing.assert_allclose(out, expected, rtol=1e-2)
+
+
+# ------------------------------------------------------------------ #
+# neighbor_allreduce: dynamic topology (reference :430-604)
+# ------------------------------------------------------------------ #
+def test_neighbor_allreduce_dynamic_one_peer(bf_ctx):
+    """Each rank sends to rank+shift, receives from rank-shift — the
+    exp2 one-peer schedule round."""
+    for shift in [1, 2, 4]:
+        dst_weights = [[(r + shift) % SIZE] for r in range(SIZE)]
+        src_weights = [{(r - shift) % SIZE: 0.5} for r in range(SIZE)]
+        x = rank_tensor((3,), np.float64)
+        out = np.asarray(bf.neighbor_allreduce(
+            x, self_weight=0.5, src_weights=src_weights,
+            dst_weights=dst_weights))
+        for r in range(SIZE):
+            expected = 0.5 * r + 0.5 * ((r - shift) % SIZE)
+            np.testing.assert_allclose(out[r], expected, atol=1e-12)
+
+
+def test_neighbor_allreduce_dynamic_dst_weighting(bf_ctx):
+    """dst_weights as dict scales sender-side (reference :834+)."""
+    shift = 2
+    dst_weights = [{(r + shift) % SIZE: 2.0} for r in range(SIZE)]
+    src_weights = [{(r - shift) % SIZE: 0.25} for r in range(SIZE)]
+    x = rank_tensor((2,), np.float64)
+    out = np.asarray(bf.neighbor_allreduce(
+        x, self_weight=0.5, src_weights=src_weights,
+        dst_weights=dst_weights))
+    for r in range(SIZE):
+        expected = 0.5 * r + 0.25 * 2.0 * ((r - shift) % SIZE)
+        np.testing.assert_allclose(out[r], expected, atol=1e-12)
+
+
+def test_neighbor_allreduce_dynamic_empty_send(bf_ctx):
+    """Ranks may send to nobody (reference empty-send case :560+)."""
+    # only rank 0 sends (to rank 1); everyone else keeps their value
+    dst_weights = [[1]] + [[] for _ in range(SIZE - 1)]
+    src_weights = [{} for _ in range(SIZE)]
+    src_weights[1] = {0: 0.5}
+    self_weight = [1.0] * SIZE
+    self_weight[1] = 0.5
+    x = rank_tensor((2,), np.float64)
+    out = np.asarray(bf.neighbor_allreduce(
+        x, self_weight=self_weight, src_weights=src_weights,
+        dst_weights=dst_weights))
+    np.testing.assert_allclose(out[1], 0.5 * 1 + 0.5 * 0, atol=1e-12)
+    for r in [0] + list(range(2, SIZE)):
+        np.testing.assert_allclose(out[r], r, atol=1e-12)
+
+
+def test_neighbor_allreduce_topo_check(bf_ctx):
+    """enable_topo_check rejects one-sided edge declarations (reference
+    mpi_controller.cc:364-417 CheckNeighborSendRecvPattern)."""
+    x = rank_tensor((2,), np.float64)
+    # rank 1 expects from rank 0, but rank 0 sends to nobody
+    src_weights = [{} for _ in range(SIZE)]
+    src_weights[1] = {0: 0.5}
+    dst_weights = [[] for _ in range(SIZE)]
+    with pytest.raises(Exception, match="mismatch"):
+        bf.neighbor_allreduce(x, self_weight=1.0, src_weights=src_weights,
+                              dst_weights=dst_weights,
+                              enable_topo_check=True)
+    # the reverse: rank 0 sends to 1, but 1 does not expect it
+    dst_weights2 = [[1]] + [[] for _ in range(SIZE - 1)]
+    src_weights2 = [{} for _ in range(SIZE)]
+    with pytest.raises(Exception, match="mismatch"):
+        bf.neighbor_allreduce(x, self_weight=1.0, src_weights=src_weights2,
+                              dst_weights=dst_weights2,
+                              enable_topo_check=True)
+    # disabling the check silently drops the one-sided edge
+    out = bf.neighbor_allreduce(x, self_weight=1.0, src_weights=src_weights,
+                                dst_weights=dst_weights,
+                                enable_topo_check=False)
+    np.testing.assert_allclose(np.asarray(out)[1], 1.0)
+
+
+def test_neighbor_allreduce_requires_weights_with_dst(bf_ctx):
+    x = rank_tensor((2,), np.float64)
+    with pytest.raises(ValueError):
+        bf.neighbor_allreduce(x, dst_weights=[[1]] * SIZE)
+
+
+def test_neighbor_allreduce_self_src_must_pair(bf_ctx):
+    x = rank_tensor((2,), np.float64)
+    with pytest.raises(ValueError):
+        bf.neighbor_allreduce(x, self_weight=0.5)
+
+
+# ------------------------------------------------------------------ #
+# neighbor_allgather (reference :1116-1285)
+# ------------------------------------------------------------------ #
+def test_neighbor_allgather_regular(bf_ctx):
+    graph = ExponentialTwoGraph(SIZE)
+    bf.set_topology(graph)
+    x = rank_tensor((2, 3), np.float32)
+    out = bf.neighbor_allgather(x)
+    # regular graph: uniform in-degree 3 -> rank-major array
+    assert out.shape == (SIZE, 3 * 2, 3)
+    host = np.asarray(out)
+    for r in range(SIZE):
+        nbrs = sorted(s for s in graph.predecessors(r) if s != r)
+        for i, s in enumerate(nbrs):
+            np.testing.assert_allclose(host[r, 2 * i:2 * i + 2], s)
+
+
+def test_neighbor_allgather_irregular(bf_ctx):
+    graph = StarGraph(SIZE)
+    bf.set_topology(graph)
+    x = rank_tensor((1, 2), np.float32)
+    out = bf.neighbor_allgather(x)
+    assert isinstance(out, list)
+    assert out[0].shape == (SIZE - 1, 2)  # center receives from all
+    np.testing.assert_allclose(out[0][:, 0], np.arange(1, SIZE))
+    for r in range(1, SIZE):
+        assert out[r].shape == (1, 2)
+        np.testing.assert_allclose(out[r], 0)
+
+
+def test_neighbor_allgather_dynamic(bf_ctx):
+    src_ranks = [[(r - 3) % SIZE] for r in range(SIZE)]
+    dst_ranks = [[(r + 3) % SIZE] for r in range(SIZE)]
+    x = rank_tensor((2,), np.float32)
+    out = bf.neighbor_allgather(x, src_ranks=src_ranks, dst_ranks=dst_ranks)
+    host = np.asarray(out)
+    for r in range(SIZE):
+        np.testing.assert_allclose(host[r], (r - 3) % SIZE)
+
+
+# ------------------------------------------------------------------ #
+# pair gossip (reference :1286-1319, skipped there; active here)
+# ------------------------------------------------------------------ #
+def test_pair_gossip_average(bf_ctx):
+    targets = [r ^ 1 for r in range(SIZE)]  # pair (0,1),(2,3),...
+    x = rank_tensor((3,), np.float64)
+    out = np.asarray(bf.pair_gossip(x, targets))
+    for r in range(SIZE):
+        np.testing.assert_allclose(out[r], (r + (r ^ 1)) / 2)
+
+
+def test_pair_gossip_weighted(bf_ctx):
+    targets = [r ^ 1 for r in range(SIZE)]
+    x = rank_tensor((2,), np.float64)
+    out = np.asarray(bf.pair_gossip(x, targets, self_weight=0.75,
+                                    pair_weight=0.25))
+    for r in range(SIZE):
+        np.testing.assert_allclose(out[r], 0.75 * r + 0.25 * (r ^ 1))
+
+
+def test_barrier(bf_ctx):
+    bf.barrier()  # smoke: must not deadlock or raise

@@ -1,0 +1,178 @@
+"""Distributed optimizer convergence tests.
+
+Mirrors reference test/torch_optimizer_test.py: train a synthetic linear
+problem with every optimizer/communication-type combo and assert the MSE
+drops below a threshold (LinearProblemBuilder design, reference :100-180).
+
+Each rank holds its own data shard (rank-major arrays); the global optimum
+is the least-squares solution over the union, so convergence proves the
+ranks actually mix information.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.optim import (
+    CommunicationType,
+    DistributedAdaptThenCombineOptimizer,
+    DistributedAdaptWithCombineOptimizer,
+    DistributedGradientAllreduceOptimizer,
+    DistributedPullGetOptimizer,
+    DistributedPushSumOptimizer,
+    DistributedWinPutOptimizer,
+)
+from bluefog_tpu.topology import ExponentialTwoGraph, RingGraph
+
+SIZE = 8
+DIM = 4
+SAMPLES = 32
+
+
+def make_problem(seed=0):
+    """Per-rank least squares: y_r = A_r w* + noise."""
+    rng = np.random.default_rng(seed)
+    w_star = rng.normal(size=(DIM, 1))
+    A = rng.normal(size=(SIZE, SAMPLES, DIM))
+    y = A @ w_star + 0.01 * rng.normal(size=(SIZE, SAMPLES, 1))
+    return A, y, w_star
+
+
+def loss_and_grad(A, y, w):
+    """Per-rank MSE gradient, computed rank-wise on host-visible arrays."""
+    pred = jnp.einsum("rsd,rdo->rso", A, w)
+    err = pred - y
+    grad = 2.0 * jnp.einsum("rsd,rso->rdo", A, err) / SAMPLES
+    loss = jnp.mean(err**2, axis=(1, 2))
+    return loss, grad
+
+
+def global_mse(A, y, w):
+    loss, _ = loss_and_grad(A, y, w)
+    return float(jnp.mean(loss))
+
+
+def run_training(opt, steps=60, lr=None, seed=0, dynamic_update=None,
+                 broadcast_init=False):
+    A, y, w_star = make_problem(seed)
+    A = bf.rank_sharded(A)
+    y = bf.rank_sharded(y)
+    # every rank starts at a different random point
+    rng = np.random.default_rng(seed + 1)
+    w = bf.rank_sharded(rng.normal(size=(SIZE, DIM, 1)))
+    params = {"w": w}
+    if broadcast_init:
+        # reference pattern: broadcast_parameters before training
+        # (torch/utility.py:26)
+        params = bf.broadcast_parameters(params, root_rank=0)
+    state = opt.init(params)
+    for i in range(steps):
+        if dynamic_update is not None:
+            dynamic_update(opt, i)
+        _, grad = loss_and_grad(A, y, params["w"])
+        params, state = opt.step(params, {"w": grad}, state)
+    # consensus check: all ranks should agree reasonably well
+    return params["w"], A, y, w_star
+
+
+@pytest.mark.parametrize("lr", [0.05])
+def test_gradient_allreduce_optimizer(bf_ctx, lr):
+    opt = DistributedGradientAllreduceOptimizer(optax.sgd(lr))
+    w, A, y, w_star = run_training(opt, steps=100, broadcast_init=True)
+    assert global_mse(A, y, w) < 0.01
+    w_host = np.asarray(w)
+    # allreduce keeps identically-initialized ranks in lockstep
+    for r in range(1, SIZE):
+        np.testing.assert_allclose(w_host[r], w_host[0], atol=1e-9)
+    np.testing.assert_allclose(w_host[0], w_star, atol=0.2)
+
+
+@pytest.mark.parametrize(
+    "comm",
+    [CommunicationType.neighbor_allreduce, CommunicationType.allreduce],
+)
+def test_adapt_with_combine_optimizer(bf_ctx, comm):
+    bf.set_topology(ExponentialTwoGraph(SIZE))
+    opt = DistributedAdaptWithCombineOptimizer(
+        optax.sgd(0.05), communication_type=comm)
+    w, A, y, w_star = run_training(opt, steps=100)
+    assert global_mse(A, y, w) < 0.02
+    w_host = np.asarray(w)
+    spread = np.max(np.std(w_host, axis=0))
+    assert spread < 0.05  # ranks reached consensus
+
+
+@pytest.mark.parametrize(
+    "comm",
+    [CommunicationType.neighbor_allreduce],
+)
+def test_adapt_then_combine_optimizer(bf_ctx, comm):
+    bf.set_topology(ExponentialTwoGraph(SIZE))
+    opt = DistributedAdaptThenCombineOptimizer(
+        optax.sgd(0.05), communication_type=comm)
+    w, A, y, w_star = run_training(opt, steps=100)
+    assert global_mse(A, y, w) < 0.02
+
+
+def test_adapt_with_combine_adam(bf_ctx):
+    """Non-SGD base optimizer (reference reimplements Adam parameter-wise,
+    optimizers.py:601-760; optax gives it for free)."""
+    bf.set_topology(ExponentialTwoGraph(SIZE))
+    opt = DistributedAdaptWithCombineOptimizer(optax.adam(0.05))
+    w, A, y, w_star = run_training(opt, steps=150)
+    assert global_mse(A, y, w) < 0.02
+
+
+def test_dynamic_topology_optimizer(bf_ctx):
+    """Dynamic one-peer exp2 schedule via mutable weight knobs (reference
+    examples/pytorch_resnet.py:333-372 dynamic_topology_update)."""
+    bf.set_topology(ExponentialTwoGraph(SIZE))
+
+    def dynamic_update(opt, i):
+        shift = 2 ** (i % 3)
+        opt.dst_weights = [[(r + shift) % SIZE] for r in range(SIZE)]
+        opt.src_weights = [{(r - shift) % SIZE: 0.5} for r in range(SIZE)]
+        opt.self_weight = 0.5
+
+    opt = DistributedAdaptWithCombineOptimizer(optax.sgd(0.05))
+    w, A, y, w_star = run_training(opt, steps=120,
+                                   dynamic_update=dynamic_update)
+    assert global_mse(A, y, w) < 0.02
+    spread = np.max(np.std(np.asarray(w), axis=0))
+    assert spread < 0.05
+
+
+def test_local_aggregation(bf_ctx):
+    """num_steps_per_communication > 1 (reference local-aggregation cases)."""
+    bf.set_topology(ExponentialTwoGraph(SIZE))
+    opt = DistributedAdaptWithCombineOptimizer(
+        optax.sgd(0.05), num_steps_per_communication=4)
+    w, A, y, w_star = run_training(opt, steps=160)
+    assert global_mse(A, y, w) < 0.05
+
+
+def test_win_put_optimizer(bf_ctx):
+    bf.set_topology(ExponentialTwoGraph(SIZE))
+    opt = DistributedWinPutOptimizer(optax.sgd(0.05))
+    w, A, y, w_star = run_training(opt, steps=100)
+    assert global_mse(A, y, w) < 0.05
+    bf.win_free()
+
+
+def test_pull_get_optimizer(bf_ctx):
+    bf.set_topology(ExponentialTwoGraph(SIZE))
+    opt = DistributedPullGetOptimizer(optax.sgd(0.05))
+    w, A, y, w_star = run_training(opt, steps=100)
+    assert global_mse(A, y, w) < 0.05
+    bf.win_free()
+
+
+def test_push_sum_optimizer(bf_ctx):
+    bf.set_topology(ExponentialTwoGraph(SIZE))
+    opt = DistributedPushSumOptimizer(optax.sgd(0.05))
+    w, A, y, w_star = run_training(opt, steps=100)
+    assert global_mse(A, y, w) < 0.05
+    bf.win_free()

@@ -1,0 +1,255 @@
+"""Topology generator tests.
+
+Mirrors reference test/torch_basics_test.py:108-215 (neighbor sets per
+topology, infer helpers) plus spec-level invariants the TPU build relies on.
+"""
+
+import networkx as nx
+import numpy as np
+import pytest
+
+from bluefog_tpu.topology import (
+    DynamicTopology,
+    ExponentialGraph,
+    ExponentialTwoGraph,
+    FullyConnectedGraph,
+    GetDynamicOnePeerSendRecvRanks,
+    GetInnerOuterExpo2DynamicSendRecvRanks,
+    GetInnerOuterRingDynamicSendRecvRanks,
+    GetRecvWeights,
+    GetSendWeights,
+    InferDestinationFromSourceRanks,
+    InferSourceFromDestinationRanks,
+    IsRegularGraph,
+    IsTopologyEquivalent,
+    MeshGrid2DGraph,
+    RingGraph,
+    StarGraph,
+    SymmetricExponentialGraph,
+    Topology,
+)
+
+
+def expected_exp2_neighbors(rank, size):
+    shifts = [s for s in range(1, size) if s & (s - 1) == 0]
+    return sorted({(rank + s) % size for s in shifts})
+
+
+@pytest.mark.parametrize("size", [4, 8, 12, 16])
+def test_exponential_two_graph_out_neighbors(size):
+    g = ExponentialTwoGraph(size)
+    for rank in range(size):
+        succ = sorted(s for s in g.successors(rank) if s != rank)
+        assert succ == expected_exp2_neighbors(rank, size)
+
+
+@pytest.mark.parametrize("size", [4, 8, 11, 16])
+def test_exponential_graph_row_stochastic(size):
+    g = ExponentialGraph(size)
+    w = nx.to_numpy_array(g)
+    np.testing.assert_allclose(w.sum(axis=1), 1.0)
+    # circulant: every row is a roll of row 0
+    for i in range(size):
+        np.testing.assert_allclose(w[i], np.roll(w[0], i))
+
+
+def test_ring_graph_styles():
+    for style, deg in [(0, 2), (1, 1), (2, 1)]:
+        g = RingGraph(8, connect_style=style)
+        for r in range(8):
+            assert len([s for s in g.successors(r) if s != r]) == deg
+    # left-ring: rank r sends to r+? left connection means neighbor r-1
+    g = RingGraph(8, connect_style=1)
+    assert sorted(d for d in g.successors(0) if d != 0) == [7]
+    g = RingGraph(8, connect_style=2)
+    assert sorted(d for d in g.successors(0) if d != 0) == [1]
+
+
+def test_mesh_grid_weights_doubly_stochastic():
+    g = MeshGrid2DGraph(12)
+    w = nx.to_numpy_array(g)
+    np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-12)
+    np.testing.assert_allclose(w.sum(axis=0), 1.0, atol=1e-12)
+    # Hastings rule is symmetric
+    np.testing.assert_allclose(w, w.T)
+
+
+def test_mesh_grid_shape_mismatch():
+    with pytest.raises(AssertionError):
+        MeshGrid2DGraph(12, shape=(3, 5))
+
+
+def test_star_graph():
+    g = StarGraph(8, center_rank=2)
+    for r in range(8):
+        nbrs = sorted(s for s in g.successors(r) if s != r)
+        if r == 2:
+            assert nbrs == [0, 1, 3, 4, 5, 6, 7]
+        else:
+            assert nbrs == [2]
+    w = nx.to_numpy_array(g)
+    np.testing.assert_allclose(w.sum(axis=0), 1.0)
+
+
+def test_fully_connected():
+    g = FullyConnectedGraph(6)
+    w = nx.to_numpy_array(g)
+    np.testing.assert_allclose(w, np.full((6, 6), 1 / 6))
+
+
+def test_symmetric_exponential_graph():
+    g = SymmetricExponentialGraph(12, base=4)
+    # shifts: 0, plus s where min-index is power of 4 => 1, 4, 8(12-8=4), 11(12-11=1)
+    succ0 = sorted(d for d in g.successors(0) if d != 0)
+    assert succ0 == [1, 4, 8, 11]
+
+
+def test_is_topology_equivalent():
+    assert IsTopologyEquivalent(ExponentialGraph(8), ExponentialGraph(8))
+    assert not IsTopologyEquivalent(ExponentialGraph(8), RingGraph(8))
+    assert not IsTopologyEquivalent(None, ExponentialGraph(8))
+    assert not IsTopologyEquivalent(ExponentialGraph(8), ExponentialGraph(9))
+
+
+def test_is_regular():
+    assert IsRegularGraph(RingGraph(8))
+    assert IsRegularGraph(FullyConnectedGraph(5))
+    assert not IsRegularGraph(StarGraph(8))
+
+
+def test_recv_send_weights_roundtrip():
+    g = MeshGrid2DGraph(8)
+    w = nx.to_numpy_array(g)
+    for r in range(8):
+        self_w, nbr = GetRecvWeights(g, r)
+        assert self_w == pytest.approx(w[r, r])
+        for src, wt in nbr.items():
+            assert wt == pytest.approx(w[src, r])
+        self_w2, out = GetSendWeights(g, r)
+        assert self_w2 == pytest.approx(w[r, r])
+        for dst, wt in out.items():
+            assert wt == pytest.approx(w[r, dst])
+
+
+# ---------------------------------------------------------------------- #
+# spec / shift decomposition
+# ---------------------------------------------------------------------- #
+@pytest.mark.parametrize(
+    "maker", [ExponentialTwoGraph, RingGraph, MeshGrid2DGraph, StarGraph,
+              FullyConnectedGraph]
+)
+def test_shift_decomposition_covers_all_edges(maker):
+    g = maker(8)
+    topo = Topology.from_graph(g)
+    w = nx.to_numpy_array(g)
+    rebuilt = np.zeros((8, 8))
+    for i in range(8):
+        rebuilt[i, i] = w[i, i]
+    for cls in topo.shift_classes:
+        for (src, dst) in cls.perm:
+            assert (dst - src) % 8 == cls.shift
+            rebuilt[src, dst] = cls.recv_weights[dst]
+    np.testing.assert_allclose(rebuilt, w)
+
+
+def test_exp2_shift_class_count():
+    # circulant exp2 over 8 ranks: shifts {1, 2, 4} -> 3 ppermutes
+    topo = Topology.from_graph(ExponentialTwoGraph(8))
+    assert len(topo.shift_classes) == 3
+
+
+def test_neighbors_from_spec():
+    topo = Topology.from_graph(ExponentialTwoGraph(8))
+    assert topo.in_neighbors(0) == [4, 6, 7]
+    assert topo.out_neighbors(0) == [1, 2, 4]
+
+
+def test_dynamic_topology_spec():
+    spec = DynamicTopology.from_edges(
+        4, {(0, 1): 0.5, (1, 2): 0.5, (2, 3): 0.5, (3, 0): 0.5},
+        self_weights=[0.5] * 4)
+    assert len(spec.shift_classes) == 1
+    cls = spec.shift_classes[0]
+    assert cls.shift == 1
+    assert cls.recv_weights == (0.5, 0.5, 0.5, 0.5)
+
+
+# ---------------------------------------------------------------------- #
+# dynamic generators (reference torch_basics_test + topology_util docs)
+# ---------------------------------------------------------------------- #
+def test_one_peer_consistency():
+    """Every round, send/recv sets across ranks must be inverses."""
+    size = 8
+    g = ExponentialTwoGraph(size)
+    gens = [GetDynamicOnePeerSendRecvRanks(g, r) for r in range(size)]
+    for _ in range(12):
+        rounds = [next(gen) for gen in gens]
+        for r, (send, recv) in enumerate(rounds):
+            assert len(send) == 1
+            for s in send:
+                # the target must list r among its recv ranks
+                assert r in rounds[s][1]
+            for src in recv:
+                assert rounds[src][0] == [r]
+
+
+def test_one_peer_exp2_is_uniform_shift():
+    """For exp2 graphs the one-peer schedule is a uniform power-of-2 shift —
+    the property that makes each round a single ppermute."""
+    size = 8
+    g = ExponentialTwoGraph(size)
+    gens = [GetDynamicOnePeerSendRecvRanks(g, r) for r in range(size)]
+    for i in range(6):
+        rounds = [next(gen) for gen in gens]
+        shifts = {(rounds[r][0][0] - r) % size for r in range(size)}
+        assert len(shifts) == 1
+        assert shifts.pop() == 2 ** (i % 3)
+
+
+def test_inner_outer_ring_consistency():
+    world, local = 8, 4
+    gens = [GetInnerOuterRingDynamicSendRecvRanks(world, local, r)
+            for r in range(world)]
+    for _ in range(10):
+        rounds = [next(gen) for gen in gens]
+        sends = [r[0][0] for r in rounds]
+        recvs = [r[1][0] for r in rounds]
+        # send map is a permutation and recv is its inverse
+        assert sorted(sends) == list(range(world))
+        for r in range(world):
+            assert recvs[sends[r]] == r
+
+
+def test_inner_outer_expo2_consistency():
+    world, local = 16, 4
+    gens = [GetInnerOuterExpo2DynamicSendRecvRanks(world, local, r)
+            for r in range(world)]
+    for _ in range(20):
+        rounds = [next(gen) for gen in gens]
+        sends = [r[0][0] for r in rounds]
+        recvs = [r[1][0] for r in rounds]
+        assert sorted(sends) == list(range(world))
+        for r in range(world):
+            assert recvs[sends[r]] == r
+
+
+def test_infer_source_from_destination():
+    dst_lists = [[1, 2], [2], [0], [0, 1]]
+    srcs = InferSourceFromDestinationRanks(dst_lists)
+    assert srcs == [[2, 3], [0, 3], [0, 1], []]
+    srcs_r, W = InferSourceFromDestinationRanks(dst_lists, True)
+    assert srcs_r == srcs
+    assert W.shape == (4, 4)
+
+
+def test_infer_destination_from_source():
+    src_lists = [[2, 3], [0, 3], [0, 1], []]
+    dsts = InferDestinationFromSourceRanks(src_lists)
+    assert dsts == [[1, 2], [2], [0], [0, 1]]
+
+
+def test_infer_rejects_bad_ranks():
+    with pytest.raises(AssertionError):
+        InferSourceFromDestinationRanks([[0], [1]])  # self rank
+    with pytest.raises(AssertionError):
+        InferSourceFromDestinationRanks([[5], []])  # out of range

@@ -1,0 +1,268 @@
+"""Window (one-sided gossip) op tests.
+
+Mirrors reference test/torch_win_ops_test.py: lifecycle (:64-140),
+win_update default/weighted/collect (:141-244), win_put/accumulate/get incl.
+partial destinations (:245-704), versions, and the associated-P push-sum
+invariant sum(p) == size (:780-863).
+"""
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.topology import ExponentialTwoGraph, RingGraph
+
+SIZE = 8
+
+
+def rank_tensor(shape, dtype=np.float64):
+    return bf.from_rank_values(lambda r: np.full(shape, r, dtype=dtype))
+
+
+# ------------------------------------------------------------------ #
+# lifecycle
+# ------------------------------------------------------------------ #
+def test_win_create_free(bf_ctx):
+    x = rank_tensor((4,))
+    assert bf.win_create(x, "w_life")
+    assert not bf.win_create(x, "w_life")  # duplicate
+    assert bf.get_current_created_window_names() == ["w_life"]
+    assert bf.win_free("w_life")
+    assert not bf.win_free("w_life")
+    assert bf.get_current_created_window_names() == []
+
+
+def test_win_free_all(bf_ctx):
+    x = rank_tensor((2,))
+    bf.win_create(x, "w_a")
+    bf.win_create(x, "w_b")
+    assert bf.win_free()
+    assert bf.get_current_created_window_names() == []
+
+
+# ------------------------------------------------------------------ #
+# win_update semantics
+# ------------------------------------------------------------------ #
+def test_win_update_initial_is_neighbor_avg(bf_ctx):
+    """Buffers init to the creator's value (not zero), so the first update
+    without puts averages self with the *initial* neighbor values
+    (reference torch_win_ops_test.py:141-170)."""
+    bf.set_topology(RingGraph(SIZE))
+    x = rank_tensor((3,))
+    bf.win_create(x, "w_upd")
+    out = np.asarray(bf.win_update("w_upd"))
+    for r in range(SIZE):
+        nbrs = [(r - 1) % SIZE, (r + 1) % SIZE]
+        expected = (r + sum(nbrs)) / 3
+        np.testing.assert_allclose(out[r], expected, atol=1e-12)
+    bf.win_free("w_upd")
+
+
+def test_win_update_zero_init(bf_ctx):
+    bf.set_topology(RingGraph(SIZE))
+    x = rank_tensor((3,))
+    bf.win_create(x, "w_zero", zero_init=True)
+    out = np.asarray(bf.win_update("w_zero"))
+    for r in range(SIZE):
+        np.testing.assert_allclose(out[r], r / 3, atol=1e-12)
+    bf.win_free("w_zero")
+
+
+def test_win_put_then_update(bf_ctx):
+    """win_put then win_update: average of self + put values
+    (reference :245-330)."""
+    bf.set_topology(RingGraph(SIZE))
+    x = rank_tensor((4,))
+    bf.win_create(x, "w_put", zero_init=True)
+    assert bf.win_put(x, "w_put")
+    out = np.asarray(bf.win_update("w_put"))
+    for r in range(SIZE):
+        nbrs = [(r - 1) % SIZE, (r + 1) % SIZE]
+        expected = (r + sum(nbrs)) / 3
+        np.testing.assert_allclose(out[r], expected, atol=1e-12)
+    bf.win_free("w_put")
+
+
+def test_win_put_partial_destinations(bf_ctx):
+    """dst_weights with a subset of out-neighbors (reference :331-420)."""
+    bf.set_topology(RingGraph(SIZE))
+    x = rank_tensor((2,))
+    bf.win_create(x, "w_part", zero_init=True)
+    # only push rightward: r -> r+1, with weight 2.0
+    dst = [{(r + 1) % SIZE: 2.0} for r in range(SIZE)]
+    assert bf.win_put(x, "w_part", dst_weights=dst)
+    # update with explicit weights reading only the left neighbor
+    nbr_w = [{(r - 1) % SIZE: 0.5} for r in range(SIZE)]
+    out = np.asarray(bf.win_update("w_part", self_weight=0.5,
+                                   neighbor_weights=nbr_w))
+    for r in range(SIZE):
+        expected = 0.5 * r + 0.5 * 2.0 * ((r - 1) % SIZE)
+        np.testing.assert_allclose(out[r], expected, atol=1e-12)
+    bf.win_free("w_part")
+
+
+def test_win_put_self_weight_scales_local(bf_ctx):
+    """win_put's self_weight multiplies the local window tensor in place
+    (reference mpi_ops.py:1161-1175 'In-place multiply')."""
+    bf.set_topology(RingGraph(SIZE))
+    x = rank_tensor((2,))
+    bf.win_create(x, "w_selfw", zero_init=True)
+    bf.win_put(x, "w_selfw", self_weight=0.5)
+    win_value = np.asarray(bf_win_value("w_selfw"))
+    for r in range(SIZE):
+        np.testing.assert_allclose(win_value[r], 0.5 * r)
+    bf.win_free("w_selfw")
+
+
+def bf_win_value(name):
+    from bluefog_tpu import api
+    return api._wm().window(name).value
+
+
+def test_win_accumulate(bf_ctx):
+    """Accumulate adds into the buffer (reference :420-520)."""
+    bf.set_topology(RingGraph(SIZE))
+    x = rank_tensor((2,))
+    bf.win_create(x, "w_acc", zero_init=True)
+    assert bf.win_accumulate(x, "w_acc")
+    assert bf.win_accumulate(x, "w_acc")  # twice -> buffers hold 2*src
+    nbr_w = [
+        {(r - 1) % SIZE: 1.0, (r + 1) % SIZE: 1.0} for r in range(SIZE)
+    ]
+    out = np.asarray(bf.win_update("w_acc", self_weight=1.0,
+                                   neighbor_weights=nbr_w))
+    for r in range(SIZE):
+        expected = r + 2 * ((r - 1) % SIZE) + 2 * ((r + 1) % SIZE)
+        np.testing.assert_allclose(out[r], expected, atol=1e-12)
+    bf.win_free("w_acc")
+
+
+def test_win_get(bf_ctx):
+    """win_get pulls the source's window value (reference :520-610)."""
+    bf.set_topology(RingGraph(SIZE))
+    x = rank_tensor((2,))
+    bf.win_create(x, "w_get", zero_init=True)
+    assert bf.win_get("w_get")
+    out = np.asarray(bf.win_update("w_get"))
+    for r in range(SIZE):
+        nbrs = [(r - 1) % SIZE, (r + 1) % SIZE]
+        expected = (r + sum(nbrs)) / 3
+        np.testing.assert_allclose(out[r], expected, atol=1e-12)
+    bf.win_free("w_get")
+
+
+def test_win_update_then_collect(bf_ctx):
+    """Collect: sum self + all buffers, then reset buffers
+    (reference :200-244)."""
+    bf.set_topology(RingGraph(SIZE))
+    x = rank_tensor((2,))
+    bf.win_create(x, "w_col", zero_init=True)
+    bf.win_put(x, "w_col")
+    out = np.asarray(bf.win_update_then_collect("w_col"))
+    for r in range(SIZE):
+        expected = r + ((r - 1) % SIZE) + ((r + 1) % SIZE)
+        np.testing.assert_allclose(out[r], expected, atol=1e-12)
+    # buffers were reset: a second collect only returns the (new) self value
+    out2 = np.asarray(bf.win_update_then_collect("w_col"))
+    np.testing.assert_allclose(out2, out, atol=1e-12)
+    bf.win_free("w_col")
+
+
+def test_win_versions(bf_ctx):
+    """Versions bump on put and clear on update (reference
+    get_win_version, mpi_ops.py:1397-1416)."""
+    bf.set_topology(RingGraph(SIZE))
+    x = rank_tensor((2,))
+    bf.win_create(x, "w_ver", zero_init=True)
+    v0 = bf.get_win_version("w_ver", rank=0)
+    assert v0 == {1: 0, 7: 0}
+    bf.win_put(x, "w_ver")
+    v1 = bf.get_win_version("w_ver", rank=0)
+    assert v1 == {1: 1, 7: 1}
+    bf.win_put(x, "w_ver")
+    assert bf.get_win_version("w_ver", rank=0) == {1: 2, 7: 2}
+    bf.win_update("w_ver")
+    assert bf.get_win_version("w_ver", rank=0) == {1: 0, 7: 0}
+    bf.win_free("w_ver")
+
+
+def test_win_mutex_and_lock_contexts(bf_ctx):
+    x = rank_tensor((2,))
+    bf.win_create(x, "w_mutex")
+    with bf.win_mutex("w_mutex"):
+        bf.win_update("w_mutex")
+    with bf.win_lock("w_mutex"):
+        pass
+    bf.win_fence("w_mutex")
+    bf.win_free("w_mutex")
+
+
+def test_win_nonblocking_handles(bf_ctx):
+    x = rank_tensor((2,))
+    bf.win_create(x, "w_nb", zero_init=True)
+    h = bf.win_put_nonblocking(x, "w_nb")
+    assert bf.win_poll(h) in (True, False)
+    assert bf.win_wait(h)
+    assert not bf.win_wait(h)  # already cleared
+    bf.win_free("w_nb")
+
+
+# ------------------------------------------------------------------ #
+# associated-P (push-sum) invariant — reference :780-863
+# ------------------------------------------------------------------ #
+def test_associated_p_sum_invariant(bf_ctx):
+    """Random async accumulate/update rounds preserve sum(p) == size when
+    weights are column-stochastic."""
+    bf.set_topology(ExponentialTwoGraph(SIZE))
+    bf.turn_on_win_ops_with_associated_p()
+    try:
+        x = rank_tensor((4,))
+        bf.win_create(x, "w_ps", zero_init=True)
+        rng = np.random.default_rng(0)
+        graph = bf.load_topology()
+        out_nbrs = {r: sorted(d for d in graph.successors(r) if d != r)
+                    for r in range(SIZE)}
+        value = x
+        for _ in range(5):
+            # column-stochastic: self + dst weights sum to 1 per source
+            alpha = {r: 1.0 / (len(out_nbrs[r]) + 1) for r in range(SIZE)}
+            dst_w = [{d: alpha[r] for d in out_nbrs[r]} for r in range(SIZE)]
+            self_w = [alpha[r] for r in range(SIZE)]
+            bf.win_accumulate(value, "w_ps", self_weight=self_w,
+                              dst_weights=dst_w)
+            value = bf.win_update_then_collect("w_ps")
+            ps = [bf.win_associated_p("w_ps", rank=r) for r in range(SIZE)]
+            np.testing.assert_allclose(sum(ps), SIZE, rtol=1e-10)
+        bf.win_free("w_ps")
+    finally:
+        bf.turn_off_win_ops_with_associated_p()
+
+
+def test_push_sum_converges_to_average(bf_ctx):
+    """The full push-sum recursion x/p -> mean(x0) (the algorithmic point of
+    associated-P, reference pytorch_optimization.py push_diging)."""
+    bf.set_topology(ExponentialTwoGraph(SIZE))
+    bf.turn_on_win_ops_with_associated_p()
+    try:
+        x0 = bf.from_rank_values(
+            lambda r: np.array([float(r), 2.0 * r]))
+        bf.win_create(x0, "w_psavg", zero_init=True)
+        graph = bf.load_topology()
+        out_nbrs = {r: sorted(d for d in graph.successors(r) if d != r)
+                    for r in range(SIZE)}
+        value = x0
+        for _ in range(60):
+            alpha = {r: 1.0 / (len(out_nbrs[r]) + 1) for r in range(SIZE)}
+            dst_w = [{d: alpha[r] for d in out_nbrs[r]} for r in range(SIZE)]
+            self_w = [alpha[r] for r in range(SIZE)]
+            bf.win_accumulate(value, "w_psavg", self_weight=self_w,
+                              dst_weights=dst_w)
+            value = bf.win_update_then_collect("w_psavg")
+        ps = np.array([bf.win_associated_p("w_psavg", rank=r)
+                       for r in range(SIZE)])
+        debiased = np.asarray(value) / ps[:, None]
+        mean = np.mean([[r, 2.0 * r] for r in range(SIZE)], axis=0)
+        np.testing.assert_allclose(debiased, np.tile(mean, (SIZE, 1)),
+                                   rtol=1e-6)
+    finally:
+        bf.turn_off_win_ops_with_associated_p()
