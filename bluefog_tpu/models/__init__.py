@@ -1,0 +1,32 @@
+"""Model zoo for BlueFog-TPU.
+
+The reference trains torchvision models (reference examples/pytorch_resnet.py:54,
+examples/pytorch_benchmark.py) and a small MNIST CNN (reference
+examples/pytorch_mnist.py:125-143).  These are TPU-first flax.linen
+re-designs: NHWC layouts, bf16 compute with f32 params, static shapes so XLA
+tiles every conv/matmul onto the MXU.
+"""
+
+from bluefog_tpu.models.mlp import MLP, MnistNet
+from bluefog_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+from bluefog_tpu.models.llama import Llama, LlamaConfig
+
+__all__ = [
+    "MLP",
+    "MnistNet",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "Llama",
+    "LlamaConfig",
+]

@@ -1,0 +1,175 @@
+"""Llama-style decoder-only transformer, TPU-first.
+
+Capability target: BASELINE.json's "Llama-3-8B decentralized SGD with
+neighbor_allreduce" stress config.  Fresh flax.linen implementation —
+RMSNorm + rotary embeddings + grouped-query attention + SwiGLU — designed
+for the MXU (bf16 compute, f32 params, static shapes) and for sequence
+parallelism: ``attn_mode='ring'`` shards the sequence over a mesh axis and
+runs :func:`bluefog_tpu.parallel.ring_attention.ring_attention`, making
+long-context first-class (the reference has none — SURVEY.md §5).
+
+The module itself never touches the mesh; under ``shard_map`` the caller
+passes ``pos_offset = axis_index * T_local`` so rotary phases line up across
+sequence shards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from bluefog_tpu.parallel.ring_attention import (
+    blockwise_attention,
+    full_attention,
+    ring_attention,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden_dim: Optional[int] = None  # default 8/3 * dim rounded to 256
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_mode: str = "full"  # full | blockwise | ring
+    attn_block_size: int = 512  # for blockwise mode
+    sp_axis: Optional[str] = None  # mesh axis for ring mode
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.hidden_dim is not None:
+            return self.hidden_dim
+        h = int(8 * self.dim / 3)
+        return ((h + 255) // 256) * 256
+
+    @staticmethod
+    def llama3_8b(**overrides) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, hidden_dim=14336, rope_theta=500000.0, **overrides)
+
+    @staticmethod
+    def tiny(**overrides) -> "LlamaConfig":
+        """Test-scale config."""
+        return LlamaConfig(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            hidden_dim=128, max_seq_len=256, **overrides)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (normed * scale).astype(x.dtype)
+
+
+def rotary_embed(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Apply rotary position embedding.  x: [B, T, H, D], positions: [T]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., ::2].astype(jnp.float32), x[..., 1::2].astype(jnp.float32)
+    out1 = x1 * cos - x2 * sin
+    out2 = x1 * sin + x2 * cos
+    out = jnp.stack([out1, out2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, pos_offset):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        hd = cfg.head_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name=name)
+        q = dense(cfg.n_heads * hd, "wq")(x).reshape(b, t, cfg.n_heads, hd)
+        k = dense(cfg.n_kv_heads * hd, "wk")(x).reshape(b, t, cfg.n_kv_heads, hd)
+        v = dense(cfg.n_kv_heads * hd, "wv")(x).reshape(b, t, cfg.n_kv_heads, hd)
+        positions = pos_offset + jnp.arange(t)
+        q = rotary_embed(q, positions, cfg.rope_theta)
+        k = rotary_embed(k, positions, cfg.rope_theta)
+        if cfg.attn_mode == "ring":
+            assert cfg.sp_axis is not None, "ring attention needs sp_axis"
+            out = ring_attention(q, k, v, cfg.sp_axis, causal=True)
+        elif cfg.attn_mode == "blockwise":
+            out = blockwise_attention(q, k, v, cfg.attn_block_size, causal=True)
+        else:
+            out = full_attention(q, k, v, causal=True)
+        out = out.reshape(b, t, cfg.n_heads * hd)
+        return dense(cfg.dim, "wo")(out)
+
+
+class FeedForward(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name=name)
+        gate = dense(cfg.ffn_dim, "w1")(x)
+        up = dense(cfg.ffn_dim, "w3")(x)
+        return dense(cfg.dim, "w2")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, pos_offset):
+        x = x + Attention(self.cfg, name="attention")(
+            RMSNorm(self.cfg.norm_eps, name="attention_norm")(x), pos_offset)
+        x = x + FeedForward(self.cfg, name="feed_forward")(
+            RMSNorm(self.cfg.norm_eps, name="ffn_norm")(x))
+        return x
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, pos_offset=0):
+        """tokens: [B, T_local] int32 -> logits [B, T_local, vocab] f32."""
+        cfg = self.cfg
+        assert tokens.shape[1] <= cfg.max_seq_len, (
+            f"sequence shard {tokens.shape[1]} exceeds max_seq_len "
+            f"{cfg.max_seq_len}")
+        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="tok_embeddings")(tokens)
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.checkpoint(Block, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block_cls(cfg, name=f"layer_{i}")(x, pos_offset)
+        x = RMSNorm(cfg.norm_eps, name="norm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="output")(x)
+        return logits

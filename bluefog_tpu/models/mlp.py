@@ -1,0 +1,53 @@
+"""Small dense / conv models for MNIST-scale experiments.
+
+Parity targets: the reference MNIST CNN (reference examples/pytorch_mnist.py:
+125-143 — two 5x5 convs with max-pool + dropout + two dense layers) and the
+linear models used by the optimizer convergence tests (reference
+test/torch_optimizer_test.py:100 LinearProblemBuilder).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MLP(nn.Module):
+    """Plain MLP: features[i] hidden widths, final layer logits."""
+
+    features: Sequence[int] = (128, 64, 10)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for width in self.features[:-1]:
+            x = nn.Dense(width, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        return nn.Dense(self.features[-1], dtype=self.dtype)(x)
+
+
+class MnistNet(nn.Module):
+    """The reference's MNIST CNN re-done in NHWC (reference
+    examples/pytorch_mnist.py:125-143): conv(10,5x5) -> pool -> conv(20,5x5)
+    -> pool -> dense(50) -> dense(10).  Dropout is omitted from the default
+    path (deterministic flag controls it) so the jitted step stays pure.
+    """
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        # x: [N, 28, 28, 1] NHWC (TPU-native layout).
+        x = x.astype(self.dtype)
+        x = nn.Conv(10, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(50, dtype=self.dtype)(x))
+        if not deterministic:
+            x = nn.Dropout(0.5, deterministic=False)(x)
+        return nn.Dense(10, dtype=self.dtype)(x)

@@ -1,0 +1,198 @@
+"""Ring attention — sequence/context parallelism over the device mesh.
+
+The reference has no sequence parallelism (SURVEY.md §5: absent), but its
+graph-neighbor ring exchange (reference bluefog/common/mpi_controller.cc:282-
+361) is exactly the communication shape ring attention needs.  This module
+makes long-context a first-class capability of the TPU build: the sequence
+axis is sharded over a mesh axis, K/V blocks rotate around the ring via
+``lax.ppermute`` while each device accumulates blockwise attention with a
+numerically-stable online softmax (flash-attention style log-sum-exp merge).
+
+Per ring step the transfer is one K/V block over ICI — the same "one unit
+delay, one payload, no conflicts" property BlueFog claims for its one-peer
+exponential graphs (reference README.rst:51-60), applied to attention.
+
+Everything is f32-accumulated regardless of payload dtype.  Must be called
+under ``shard_map`` with ``axis_name`` bound and the sequence axis sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "blockwise_attention", "full_attention"]
+
+_NEG_INF = -1e30
+
+
+def _merge_block(carry_m, carry_l, carry_acc, scores, v):
+    """Online-softmax merge of one score block into the running state.
+
+    carry_m: [B, H, Tq]      running row max
+    carry_l: [B, H, Tq]      running denominator
+    carry_acc: [B, H, Tq, D] running numerator
+    scores: [B, H, Tq, Tk]   this block's logits (already masked)
+    v: [B, Tk, H, D]         this block's values
+    """
+    block_m = jnp.max(scores, axis=-1)
+    new_m = jnp.maximum(carry_m, block_m)
+    correction = jnp.exp(carry_m - new_m)
+    p = jnp.exp(scores - new_m[..., None])  # [B, H, Tq, Tk]
+    new_l = carry_l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    new_acc = carry_acc * correction[..., None] + pv
+    return new_m, new_l, new_acc
+
+
+def _block_scores(q, k, q_offset, kv_offset, scale, causal):
+    """Scaled dot-product logits for one (Q block, KV block) pair with the
+    causal mask applied in *global* coordinates."""
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(tq)
+        kv_pos = kv_offset + jnp.arange(tk)
+        mask = q_pos[:, None] >= kv_pos[None, :]  # [Tq, Tk]
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    return scores
+
+
+def _repeat_kv(k, v, n_heads):
+    """Grouped-query attention: tile KV heads up to the query head count."""
+    n_kv = k.shape[2]
+    if n_kv == n_heads:
+        return k, v
+    assert n_heads % n_kv == 0, (n_heads, n_kv)
+    rep = n_heads // n_kv
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Blockwise attention with K/V rotating around the mesh-axis ring.
+
+    q: [B, T_local, H, D], k/v: [B, T_local, H_kv, D] — the local sequence
+    shard of each array.  Returns [B, T_local, H, D] in q's dtype.
+
+    At ring step s, this device holds the K/V block that originated on rank
+    ``(idx - s) mod n``; after the local merge the block moves to rank
+    ``idx + 1``.  n steps cover the full sequence.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t_local, n_heads, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    q_offset = idx * t_local
+    m0 = jnp.full((b, n_heads, t_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n_heads, t_local), jnp.float32)
+    acc0 = jnp.zeros((b, n_heads, t_local, d), jnp.float32)
+    shift = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge(m, l, acc, k_blk, v_blk, s):
+        kv_offset = ((idx - s) % n) * t_local
+        # GQA heads are widened only here, locally — the ring carries the
+        # narrow [B, T, H_kv, D] blocks, so ICI traffic stays minimal.
+        k_full, v_full = _repeat_kv(k_blk, v_blk, n_heads)
+        scores = _block_scores(q, k_full, q_offset, kv_offset, scale, causal)
+        return _merge_block(m, l, acc, scores, v_full)
+
+    # Step 0 is the resident (self) block: no transfer needed.
+    m0, l0, acc0 = merge(m0, l0, acc0, k, v, 0)
+
+    def body(carry, s):
+        k_blk, v_blk, m, l, acc = carry
+        # Rotate first, then merge — the scan runs n-1 times, so no K/V
+        # transfer is ever discarded; XLA overlaps ppermute with compute.
+        k_blk = lax.ppermute(k_blk, axis_name, shift)
+        v_blk = lax.ppermute(v_blk, axis_name, shift)
+        m, l, acc = merge(m, l, acc, k_blk, v_blk, s)
+        return (k_blk, v_blk, m, l, acc), None
+
+    (_, _, m, l, acc), _ = lax.scan(
+        body, (k, v, m0, l0, acc0), jnp.arange(1, n)
+    )
+    # Rows with no unmasked key (can't happen for causal with self block,
+    # but guard anyway) divide by max(l, tiny).
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_size: int,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Single-device blockwise (memory-efficient) attention with the same
+    online-softmax math as :func:`ring_attention` — HBM-friendly for long
+    sequences on one chip.  q/k/v: [B, T, H(,_kv), D]."""
+    b, t, n_heads, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    k, v = _repeat_kv(k, v, n_heads)
+    assert t % block_size == 0, (t, block_size)
+    n_blocks = t // block_size
+    k_blocks = k.reshape(b, n_blocks, block_size, n_heads, d)
+    v_blocks = v.reshape(b, n_blocks, block_size, n_heads, d)
+
+    def q_block_attn(q_blk, q_idx):
+        m = jnp.full((b, n_heads, block_size), _NEG_INF, jnp.float32)
+        l = jnp.zeros((b, n_heads, block_size), jnp.float32)
+        acc = jnp.zeros((b, n_heads, block_size, d), jnp.float32)
+
+        def body(kv_idx, carry):
+            m, l, acc = carry
+            scores = _block_scores(
+                q_blk, k_blocks[:, kv_idx], q_idx * block_size,
+                kv_idx * block_size, scale, causal)
+            return _merge_block(m, l, acc, scores, v_blocks[:, kv_idx])
+
+        # Causal: KV blocks strictly above the diagonal are fully masked —
+        # skip them instead of computing all-masked score blocks.
+        upper = q_idx + 1 if causal else n_blocks
+        m, l, acc = lax.fori_loop(0, upper, body, (m, l, acc))
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        return jnp.einsum("bhqd->bqhd", out)
+
+    q_blocks = q.reshape(b, n_blocks, block_size, n_heads, d)
+    outs = lax.map(
+        lambda i: q_block_attn(q_blocks[:, i], i), jnp.arange(n_blocks)
+    )  # [n_blocks, B, block, H, D]
+    out = jnp.moveaxis(outs, 0, 1).reshape(b, t, n_heads, d)
+    return out.astype(q.dtype)
+
+
+def full_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Dense reference attention (q/k/v: [B, T, H(,_kv), D])."""
+    b, t, n_heads, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    k, v = _repeat_kv(k, v, n_heads)
+    scores = _block_scores(q, k, 0, 0, scale, causal)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
