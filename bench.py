@@ -1,0 +1,110 @@
+"""Headline benchmark: ResNet-50 training throughput (images/sec/chip).
+
+Mirrors the reference's benchmark protocol (reference
+examples/pytorch_benchmark.py: synthetic ImageNet-shaped data, batch 64,
+timed steady-state steps).  The reference's published number is 4310.6
+img/sec TOTAL on 16 V100s with neighbor_allreduce (docs/performance.rst:15-23)
+= 269.4 img/sec/GPU, which is the ``vs_baseline`` denominator here.
+
+Runs the same fully-jitted decentralized train-step code path used
+multi-chip (bluefog_tpu.optim.functional) on however many chips are
+attached (driver: one v5e chip), with train-mode batch norm, bf16 compute.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_IMG_PER_SEC_PER_CHIP = 4310.6 / 16  # docs/performance.rst:15-23
+BATCH_PER_CHIP = 64
+WARMUP_STEPS = 5
+TIMED_STEPS = 30
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from bluefog_tpu import models
+    from bluefog_tpu.context import _uniform_topology_spec
+    from bluefog_tpu.optim import functional as F
+    from bluefog_tpu.topology.graphs import ExponentialTwoGraph
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("bf",))
+
+    model = models.ResNet50(num_classes=1000)  # bf16 compute, f32 params
+
+    def loss_fn(params, aux, batch):
+        images, labels = batch
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": aux}, images, train=True,
+            mutable=["batch_stats"])
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+        return loss, updates["batch_stats"]
+
+    if n > 1:
+        topo = dict(topology=_uniform_topology_spec(ExponentialTwoGraph(n)))
+        comm_mode = "atc"
+    else:
+        topo = dict()
+        comm_mode = "none"
+    opt = optax.sgd(0.1, momentum=0.9)
+    step_fn = F.build_train_step(
+        loss_fn, opt, mesh, comm_mode=comm_mode, has_aux=True, **topo)
+
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.ones((BATCH_PER_CHIP, 224, 224, 3), jnp.bfloat16)
+    variables = model.init(rng, sample)
+    params = F.rank_major(variables["params"], mesh)
+    aux = F.rank_major(variables["batch_stats"], mesh)
+    opt_state = F.rank_major(opt.init(variables["params"]), mesh)
+
+    images = np.random.RandomState(0).randn(
+        n, BATCH_PER_CHIP, 224, 224, 3).astype(np.float32)
+    labels = np.random.RandomState(1).randint(
+        0, 1000, size=(n, BATCH_PER_CHIP)).astype(np.int32)
+    sharding = NamedSharding(mesh, P("bf"))
+    batch = (jax.device_put(jnp.asarray(images, jnp.bfloat16), sharding),
+             jax.device_put(labels, sharding))
+
+    # NOTE: jax.block_until_ready can be a no-op over remote-tunnel
+    # backends; a device_get of the scalar loss is the reliable sync.
+    sync = lambda a: np.asarray(jax.device_get(a))
+
+    for i in range(WARMUP_STEPS):
+        params, aux, opt_state, loss = step_fn(params, aux, opt_state, batch,
+                                               jnp.int32(i))
+    sync(loss)
+
+    # one round-trip of a ready scalar = the fetch overhead to subtract
+    t0 = time.perf_counter()
+    sync(loss)
+    rtt = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for i in range(TIMED_STEPS):
+        params, aux, opt_state, loss = step_fn(
+            params, aux, opt_state, batch, jnp.int32(WARMUP_STEPS + i))
+    sync(loss)
+    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+
+    total_img_per_sec = n * BATCH_PER_CHIP * TIMED_STEPS / dt
+    per_chip = total_img_per_sec / n
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_chip / REFERENCE_IMG_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

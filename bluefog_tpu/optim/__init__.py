@@ -17,3 +17,9 @@ from bluefog_tpu.optim.wrappers import (  # noqa: F401
     DistributedPullGetOptimizer,
     DistributedPushSumOptimizer,
 )
+from bluefog_tpu.optim.functional import (  # noqa: F401
+    build_train_step,
+    consensus_distance,
+    rank_major,
+    rank_spec_tree,
+)

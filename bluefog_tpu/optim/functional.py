@@ -1,0 +1,222 @@
+"""Fully-jitted decentralized train steps.
+
+The eager optimizer wrappers (``bluefog_tpu.optim.wrappers``) mirror the
+reference's host-driven hook model (reference bluefog/torch/optimizers.py) —
+good for parity, but each op is a separate dispatch.  This module is the
+TPU-first fast path: ONE compiled SPMD program per train step containing
+forward, backward, the base optax update, and the decentralized combine —
+XLA overlaps the ppermutes with compute, exactly what the reference gets
+from its background thread + tensor fusion (reference
+common/operations.cc:453-1020), but compiler-scheduled instead of
+hand-scheduled.
+
+Key design points (SURVEY.md §7 "hard parts"):
+
+* **Dynamic topologies without retrace storms** — pass ``schedule`` (a list
+  of topology specs, e.g. the log2(n) one-peer exponential-2 rounds); the
+  step index selects the round's combine via ``lax.switch`` inside the one
+  compiled program.  No retracing, no host round-trip per iteration.
+* **Rank-major state** — every rank owns its own parameters (decentralized
+  DP: nothing is replicated).  Params/opt-state/batch leaves all carry a
+  leading ``[n_ranks]`` axis sharded over ``axis_name``; use
+  :func:`rank_major` / :func:`rank_spec_tree` to build them.
+* **Sequence parallelism composes** — give the mesh an extra axis and pass
+  ``sp_axis``; gradients are psum-reduced over it (params are replicated
+  across sp), so a ring-attention model trains with dp x sp on one mesh.
+
+Combine math is f32-accumulated via the shard-level kernels in
+``bluefog_tpu.parallel.collectives``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bluefog_tpu.parallel import collectives as C
+from bluefog_tpu.topology.spec import DynamicTopology, Topology
+
+CommSpec = Union[Topology, DynamicTopology]
+
+__all__ = [
+    "build_train_step",
+    "rank_major",
+    "rank_spec_tree",
+    "consensus_distance",
+]
+
+
+def rank_major(tree, mesh: Mesh, axis_name: str = "bf"):
+    """Stack ``n`` copies of every leaf along a new leading rank axis and
+    shard it over ``axis_name`` — the initial state of decentralized
+    training where every rank starts from the same point (the reference
+    gets this from broadcast_parameters, torch/utility.py:26)."""
+    n = mesh.shape[axis_name]
+    sharding = NamedSharding(mesh, P(axis_name))
+
+    def stack(leaf):
+        leaf = jnp.asarray(leaf)
+        return jax.device_put(
+            jnp.broadcast_to(leaf[None], (n,) + leaf.shape), sharding)
+
+    return jax.tree.map(stack, tree)
+
+
+def rank_spec_tree(tree, axis_name: str = "bf"):
+    """PartitionSpec tree: leading rank axis on every leaf."""
+    return jax.tree.map(lambda _: P(axis_name), tree)
+
+
+def consensus_distance(params) -> jax.Array:
+    """Mean squared distance of each rank's parameters from the rank-mean —
+    the standard measure of decentralized disagreement.  ``params`` is
+    rank-major."""
+    leaves = jax.tree.leaves(params)
+    total = 0.0
+    count = 0
+    for leaf in leaves:
+        mean = jnp.mean(leaf, axis=0, keepdims=True)
+        total = total + jnp.sum((leaf - mean) ** 2)
+        count += leaf[0].size
+    return total / count
+
+
+def _combine_fn(spec: CommSpec, axis_name: str,
+                hierarchical_local_size: Optional[int]) -> Callable:
+    if hierarchical_local_size is not None:
+        return lambda tree: jax.tree.map(
+            lambda p: C.hierarchical_neighbor_allreduce(
+                p, spec, hierarchical_local_size, axis_name), tree)
+    return lambda tree: jax.tree.map(
+        lambda p: C.neighbor_allreduce(p, spec, axis_name), tree)
+
+
+def build_train_step(
+    loss_fn: Callable[[Any, Any], jax.Array],
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    axis_name: str = "bf",
+    comm_mode: str = "cta",
+    topology: Optional[CommSpec] = None,
+    schedule: Optional[Sequence[CommSpec]] = None,
+    num_steps_per_communication: int = 1,
+    hierarchical_local_size: Optional[int] = None,
+    sp_axis: Optional[str] = None,
+    batch_specs: Any = None,
+    donate: bool = True,
+    has_aux: bool = False,
+) -> Callable:
+    """Compile one decentralized SGD/optax step over ``mesh``.
+
+    loss_fn(params, batch) -> scalar loss, evaluated per rank on its local
+    shard (under ``shard_map``; it may use ``sp_axis`` collectives, e.g.
+    ring attention).  With ``has_aux=True`` the signature becomes
+    ``loss_fn(params, aux, batch) -> (loss, new_aux)`` for mutable model
+    state (e.g. batch-norm statistics), and the returned step takes and
+    returns the rank-major ``aux`` tree:
+    ``train_step(params, aux, opt_state, batch, step)``.
+
+    comm_mode:
+      * ``"cta"``  — combine-then-adapt (reference _DistributedReduceOptimizer)
+      * ``"atc"``  — adapt-then-combine (reference _DistributedAdaptThenCombine)
+      * ``"gradient_allreduce"`` — global gradient averaging (reference
+        _DistributedOptimizer)
+      * ``"none"`` — no communication (pure local SGD)
+
+    Exactly one of ``topology`` (static) or ``schedule`` (dynamic, indexed
+    by ``step % len(schedule)`` via ``lax.switch``) for the neighbor modes.
+
+    Returns ``train_step(params, opt_state, batch, step) ->
+    (params, opt_state, loss)`` — all rank-major, jit-compiled with
+    params/opt_state donated.
+    """
+    if comm_mode not in ("cta", "atc", "gradient_allreduce", "none"):
+        raise ValueError(f"unknown comm_mode {comm_mode!r}")
+    needs_topo = comm_mode in ("cta", "atc")
+    if needs_topo and (topology is None) == (schedule is None):
+        raise ValueError(
+            "neighbor modes need exactly one of topology= or schedule=")
+
+    specs = list(schedule) if schedule is not None else (
+        [topology] if topology is not None else [])
+    branches = [
+        _combine_fn(s, axis_name, hierarchical_local_size) for s in specs
+    ]
+    k_comm = int(num_steps_per_communication)
+
+    def combine(params, step):
+        if not branches:
+            return params
+        if len(branches) == 1:
+            combined = branches[0](params)
+        else:
+            combined = lax.switch(step % len(branches), branches, params)
+        if k_comm > 1:
+            return jax.tree.map(
+                lambda new, old: jnp.where(step % k_comm == 0, new, old),
+                combined, params)
+        return combined
+
+    def per_rank_step(params, aux, opt_state, batch, step):
+        if has_aux:
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, aux, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            new_aux = aux
+        if sp_axis is not None:
+            # Params are replicated over the sequence axis; each sp shard
+            # saw a different sequence slice, so reduce both.
+            grads = lax.pmean(grads, sp_axis)
+            loss = lax.pmean(loss, sp_axis)
+        if comm_mode == "gradient_allreduce":
+            grads = jax.tree.map(
+                lambda g: C.allreduce(g, axis_name, average=True), grads)
+        if comm_mode == "cta":
+            params = combine(params, step)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if comm_mode == "atc":
+            params = combine(params, step)
+        return params, new_aux, opt_state, loss
+
+    squeeze = lambda t: jax.tree.map(lambda x: x[0], t)
+    expand = lambda t: jax.tree.map(lambda x: x[None], t)
+
+    def wrapped(params, aux, opt_state, batch, step):
+        # strip the leading per-shard rank axis of size 1
+        params, aux, opt_state, loss = per_rank_step(
+            squeeze(params), squeeze(aux), squeeze(opt_state),
+            squeeze(batch), step)
+        return (expand(params), expand(aux), expand(opt_state),
+                jnp.reshape(loss, (1,)))
+
+    p_rank = P(axis_name)
+    if batch_specs is None:
+        batch_specs = p_rank
+    sm = jax.shard_map(
+        wrapped,
+        mesh=mesh,
+        in_specs=(p_rank, p_rank, p_rank, batch_specs, P()),
+        out_specs=(p_rank, p_rank, p_rank, p_rank),
+        check_vma=False,
+    )
+    donate_argnums = (0, 1, 2) if donate else ()
+    jitted = jax.jit(sm, donate_argnums=donate_argnums)
+    if has_aux:
+        return jitted
+
+    def no_aux_step(params, opt_state, batch, step):
+        params, _, opt_state, loss = jitted(
+            params, (), opt_state, batch, step)
+        return params, opt_state, loss
+
+    return no_aux_step

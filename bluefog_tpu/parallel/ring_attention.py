@@ -147,11 +147,14 @@ def blockwise_attention(
     b, t, n_heads, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    k, v = _repeat_kv(k, v, n_heads)
+    block_size = min(block_size, t)
     assert t % block_size == 0, (t, block_size)
     n_blocks = t // block_size
-    k_blocks = k.reshape(b, n_blocks, block_size, n_heads, d)
-    v_blocks = v.reshape(b, n_blocks, block_size, n_heads, d)
+    # K/V stay narrow ([.., H_kv, ..]) — GQA heads are widened per block
+    # inside the loop body, never materialized for the whole sequence.
+    n_kv = k.shape[2]
+    k_blocks = k.reshape(b, n_blocks, block_size, n_kv, d)
+    v_blocks = v.reshape(b, n_blocks, block_size, n_kv, d)
 
     def q_block_attn(q_blk, q_idx):
         m = jnp.full((b, n_heads, block_size), _NEG_INF, jnp.float32)
@@ -160,23 +163,24 @@ def blockwise_attention(
 
         def body(kv_idx, carry):
             m, l, acc = carry
+            k_full, v_full = _repeat_kv(
+                k_blocks[:, kv_idx], v_blocks[:, kv_idx], n_heads)
             scores = _block_scores(
-                q_blk, k_blocks[:, kv_idx], q_idx * block_size,
+                q_blk, k_full, q_idx * block_size,
                 kv_idx * block_size, scale, causal)
-            return _merge_block(m, l, acc, scores, v_blocks[:, kv_idx])
+            return _merge_block(m, l, acc, scores, v_full)
 
         # Causal: KV blocks strictly above the diagonal are fully masked —
-        # skip them instead of computing all-masked score blocks.
+        # skip them.  q_idx is a Python int, so the bound is static and the
+        # loop stays reverse-mode differentiable.
         upper = q_idx + 1 if causal else n_blocks
         m, l, acc = lax.fori_loop(0, upper, body, (m, l, acc))
         out = acc / jnp.maximum(l, 1e-30)[..., None]
         return jnp.einsum("bhqd->bqhd", out)
 
     q_blocks = q.reshape(b, n_blocks, block_size, n_heads, d)
-    outs = lax.map(
-        lambda i: q_block_attn(q_blocks[:, i], i), jnp.arange(n_blocks)
-    )  # [n_blocks, B, block, H, D]
-    out = jnp.moveaxis(outs, 0, 1).reshape(b, t, n_heads, d)
+    outs = [q_block_attn(q_blocks[:, i], i) for i in range(n_blocks)]
+    out = jnp.stack(outs, axis=1).reshape(b, t, n_heads, d)
     return out.astype(q.dtype)
 
 

@@ -52,6 +52,18 @@ def test_ring_matches_full(causal, hkv):
                                rtol=1e-4, atol=1e-4)
 
 
+def test_blockwise_gradients_match_full():
+    """Causal blockwise must stay reverse-mode differentiable (static
+    per-q-block loop bounds) and agree with dense grads."""
+    q, k, v = _qkv(jax.random.PRNGKey(3), 1, 64, 4, 2, 8)
+    g_blk = jax.grad(lambda q: jnp.sum(
+        blockwise_attention(q, k, v, 16, causal=True) ** 2))(q)
+    g_full = jax.grad(lambda q: jnp.sum(
+        full_attention(q, k, v, causal=True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g_blk), np.asarray(g_full),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_ring_gradients_match_full():
     """d(sum(attn))/dq must agree between ring and dense paths."""
     n = 4
