@@ -78,6 +78,7 @@ from bluefog_tpu.api import (  # noqa: F401
     win_get_nonblocking,
     win_accumulate,
     win_accumulate_nonblocking,
+    win_set_value,
     win_wait,
     win_poll,
     win_mutex,

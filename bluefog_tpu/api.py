@@ -35,6 +35,35 @@ _win_manager: Optional[WindowManager] = None
 # ------------------------------------------------------------------ #
 # lifecycle (reference basics.py:49-76)
 # ------------------------------------------------------------------ #
+_distributed_initialized = False
+
+
+def _maybe_init_distributed() -> None:
+    """Join the jax.distributed job described by the BLUEFOG_TPU_* env vars
+    that ``bfrun`` sets (bluefog_tpu/run/run.py) — must happen before the
+    first backend touch."""
+    global _distributed_initialized
+    import os
+
+    coord = os.environ.get("BLUEFOG_TPU_COORDINATOR")
+    nproc = int(os.environ.get("BLUEFOG_TPU_NUM_PROCESSES", "1"))
+    if _distributed_initialized or not coord or nproc <= 1:
+        return
+    pid_str = os.environ.get("BLUEFOG_TPU_PROCESS_ID")
+    if pid_str is None:
+        raise BluefogError(
+            "BLUEFOG_TPU_COORDINATOR and BLUEFOG_TPU_NUM_PROCESSES are set "
+            "but BLUEFOG_TPU_PROCESS_ID is missing; every process must "
+            "export its id (bfrun sets all three).")
+    pid = int(pid_str)
+    try:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+    except RuntimeError as exc:  # already initialized by the platform
+        logger.warning("jax.distributed.initialize skipped: %s", exc)
+    _distributed_initialized = True
+
+
 def init(topology_fn=None, is_weighted: bool = False, *,
          devices=None, local_size: Optional[int] = None) -> None:
     """Initialize the global context over ``devices`` (default: all).
@@ -44,6 +73,7 @@ def init(topology_fn=None, is_weighted: bool = False, *,
     default ExponentialGraph).
     """
     global _win_manager
+    _maybe_init_distributed()
     ctx = BluefogContext(devices=devices, local_size=local_size)
     ctx_mod.set_context(ctx)
     _win_manager = WindowManager(ctx)
@@ -516,6 +546,13 @@ def win_get_nonblocking(name: str, src_weights=None,
 
 def win_get(name: str, src_weights=None, require_mutex: bool = False) -> bool:
     return win_wait(win_get_nonblocking(name, src_weights, require_mutex))
+
+
+def win_set_value(name: str, tensor) -> None:
+    """Replace the window's base tensor (TPU-build addition: the reference
+    mutates the registered torch tensor in place, mpi_win_ops.cc:83-105;
+    immutable jax arrays need an explicit rebind)."""
+    _wm().set_value(name, tensor)
 
 
 def win_wait(handle: int) -> bool:

@@ -298,7 +298,19 @@ class BluefogContext:
         return NamedSharding(self.mesh, P(AXIS))
 
     def rank_sharded(self, array) -> jax.Array:
-        """Shard an existing ``[size, ...]`` array over the rank axis."""
+        """Shard an existing ``[size, ...]`` array over the rank axis.
+
+        Multi-process: every process passes the same full host array; each
+        contributes only its addressable shards (the SPMD contract — all
+        processes execute the same program on the same logical values)."""
+        if not isinstance(array, jax.Array) and jax.process_count() > 1:
+            array = np.asarray(array)
+            if array.shape[0] != self._size:
+                raise BluefogError(
+                    f"rank-major arrays need leading dim {self._size}, "
+                    f"got {array.shape}")
+            return jax.make_array_from_callback(
+                array.shape, self.rank_spec(), lambda idx: array[idx])
         array = jnp.asarray(array)
         if array.shape[0] != self._size:
             raise BluefogError(

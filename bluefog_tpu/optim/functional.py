@@ -84,7 +84,7 @@ def consensus_distance(params) -> jax.Array:
     for leaf in leaves:
         mean = jnp.mean(leaf, axis=0, keepdims=True)
         total = total + jnp.sum((leaf - mean) ** 2)
-        count += leaf[0].size
+        count += leaf.size
     return total / count
 
 
@@ -155,15 +155,17 @@ def build_train_step(
     def combine(params, step):
         if not branches:
             return params
-        if len(branches) == 1:
-            combined = branches[0](params)
-        else:
-            combined = lax.switch(step % len(branches), branches, params)
+
+        def run(params):
+            if len(branches) == 1:
+                return branches[0](params)
+            return lax.switch(step % len(branches), branches, params)
+
         if k_comm > 1:
-            return jax.tree.map(
-                lambda new, old: jnp.where(step % k_comm == 0, new, old),
-                combined, params)
-        return combined
+            # lax.cond actually skips the collectives on off-cycle steps
+            # (a select/where would still execute them every step).
+            return lax.cond(step % k_comm == 0, run, lambda p: p, params)
+        return run(params)
 
     def per_rank_step(params, aux, opt_state, batch, step):
         if has_aux:

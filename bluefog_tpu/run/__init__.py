@@ -1,0 +1,1 @@
+"""bfrun launcher package (reference bluefog/run/)."""

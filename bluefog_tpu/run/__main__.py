@@ -1,0 +1,8 @@
+"""``python -m bluefog_tpu.run`` == ``bfrun``."""
+
+import sys
+
+from bluefog_tpu.run.run import main
+
+if __name__ == "__main__":
+    sys.exit(main())

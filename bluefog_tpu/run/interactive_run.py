@@ -1,0 +1,44 @@
+"""``ibfrun`` — interactive (Jupyter) cluster launcher.
+
+Reference parity: bluefog/run/interactive_run.py starts/stops an
+ipyparallel cluster so notebook cells can drive a BlueFog job.  On TPU the
+single-controller JAX model makes most notebook use direct (one process
+sees all chips), so this exists for the multi-process case only and is
+gated on ipyparallel being installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ibfrun", description="Interactive BlueFog-TPU cluster "
+        "(reference interactive_run.py)")
+    parser.add_argument("action", choices=["start", "stop"])
+    parser.add_argument("-np", "--num-proc", type=int, default=1)
+    parser.add_argument("--profile", default="bluefog")
+    args = parser.parse_args(argv)
+
+    try:
+        import ipyparallel  # noqa: F401
+    except ImportError:
+        sys.stderr.write(
+            "ibfrun requires ipyparallel, which is not installed.\n"
+            "Single-process TPU notebooks do not need ibfrun: one process "
+            "addresses every chip — just `import bluefog_tpu` and init().\n")
+        return 1
+
+    import subprocess
+    if args.action == "start":
+        cmd = ["ipcluster", "start", f"--profile={args.profile}",
+               f"--n={args.num_proc}", "--daemonize"]
+    else:
+        cmd = ["ipcluster", "stop", f"--profile={args.profile}"]
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

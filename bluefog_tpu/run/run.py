@@ -1,0 +1,171 @@
+"""``bfrun`` — multi-process launcher for BlueFog-TPU jobs.
+
+The reference's ``bfrun`` wraps ``mpirun`` with ssh reachability checks and
+NIC discovery (reference bluefog/run/run.py:121-203).  On TPU none of that
+exists: pods are launched by the platform (one process per host) and
+``jax.distributed`` rendezvouses through a coordinator address.  This
+launcher covers the two launch shapes:
+
+* **Local multi-process** (default): spawn ``-np`` processes on this host,
+  each a ``jax.distributed`` member.  With ``--force-cpu-devices K`` each
+  process simulates K CPU devices — the single-host stand-in for a pod,
+  used by the multi-process test suite (SURVEY.md §4).
+* **Multi-host**: run the same ``bfrun`` command on every host with
+  ``--host-rank R --coordinator HOST0:PORT`` (or let the TPU platform's
+  launcher set the env) — no ssh orchestration needed, matching how TPU
+  pods actually start jobs.
+
+Child processes receive ``BLUEFOG_TPU_{COORDINATOR,NUM_PROCESSES,
+PROCESS_ID}``; ``bluefog_tpu.init()`` picks these up and calls
+``jax.distributed.initialize`` before touching the backend.
+
+Env passthrough mirrors the reference's whitelist behavior
+(reference run.py:180-203): BLUEFOG_*, JAX_*, XLA_* and the usual PATH/
+PYTHON* variables are forwarded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+PASS_PREFIXES = ("BLUEFOG_", "JAX_", "XLA_", "TPU_", "PYTHON", "PATH",
+                 "HOME", "LD_", "TMPDIR", "VIRTUAL_ENV")
+
+
+def make_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="bfrun",
+        usage="bfrun [options] <command> [args...]",
+        description="Launch a BlueFog-TPU job (reference bfrun, run.py:58-118).")
+    parser.add_argument("-v", "--version", action="store_true")
+    parser.add_argument("-np", "--num-proc", type=int, default=1,
+                        help="total number of processes")
+    parser.add_argument("--coordinator", default="127.0.0.1:7675",
+                        help="jax.distributed coordinator address host:port")
+    parser.add_argument("--host-rank", type=int, default=0,
+                        help="this host's index when launching multi-host "
+                             "by hand (process ids are offset by "
+                             "host_rank * procs_per_host)")
+    parser.add_argument("--procs-per-host", type=int, default=None,
+                        help="processes started on THIS host "
+                             "(default: num-proc, i.e. single-host)")
+    parser.add_argument("--force-cpu-devices", type=int, default=None,
+                        metavar="K",
+                        help="simulate K CPU devices per process "
+                             "(testing; sets XLA_FLAGS + JAX_PLATFORMS)")
+    parser.add_argument("--timeline-filename", default=None,
+                        help="enable the timeline and write per-rank trace "
+                             "files with this prefix (reference "
+                             "run.py:106)")
+    parser.add_argument("--extra-env", action="append", default=[],
+                        metavar="K=V", help="extra env for the children")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="the program to run")
+    return parser
+
+
+def _child_env(args, process_id: int) -> dict:
+    env = {k: v for k, v in os.environ.items()
+           if k.startswith(PASS_PREFIXES)}
+    env["BLUEFOG_TPU_COORDINATOR"] = args.coordinator
+    env["BLUEFOG_TPU_NUM_PROCESSES"] = str(args.num_proc)
+    env["BLUEFOG_TPU_PROCESS_ID"] = str(process_id)
+    if args.force_cpu_devices:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{args.force_cpu_devices}")
+    if args.timeline_filename:
+        env["BLUEFOG_TIMELINE"] = args.timeline_filename
+    for kv in args.extra_env:
+        k, _, v = kv.partition("=")
+        env[k] = v
+    return env
+
+
+def _stream(proc: subprocess.Popen, rank: int):
+    for line in proc.stdout:
+        sys.stdout.write(f"[{rank}]<stdout> {line}")
+        sys.stdout.flush()
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    if args.version:
+        from bluefog_tpu.version import __version__
+        print(f"bfrun (bluefog_tpu) {__version__}")
+        return 0
+    if not args.command:
+        make_parser().print_usage()
+        return 2
+
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    procs_per_host = args.procs_per_host or args.num_proc
+    base_id = args.host_rank * procs_per_host
+    if base_id + procs_per_host > args.num_proc:
+        sys.stderr.write("bfrun: host-rank/procs-per-host exceed -np\n")
+        return 2
+
+    children = []
+    threads = []
+
+    def _terminate_all(sig=signal.SIGTERM):
+        for proc in children:
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(sig)
+                except OSError:
+                    pass
+
+    try:
+        for i in range(procs_per_host):
+            env = _child_env(args, base_id + i)
+            proc = subprocess.Popen(
+                command, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            children.append(proc)
+            t = threading.Thread(target=_stream, args=(proc, base_id + i),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        # One failed rank must bring the job down (the others may be
+        # blocked in collective rendezvous waiting for it forever).
+        rc = 0
+        alive = list(children)
+        while alive:
+            for proc in list(alive):
+                code = proc.poll()
+                if code is None:
+                    continue
+                alive.remove(proc)
+                if code != 0:
+                    rc = rc or code
+                    sys.stderr.write(
+                        f"bfrun: rank {children.index(proc) + base_id} "
+                        f"exited with {code}; terminating the job\n")
+                    _terminate_all()
+            if alive:
+                time.sleep(0.1)
+        for t in threads:
+            t.join(timeout=5)
+        return rc
+    except KeyboardInterrupt:
+        _terminate_all(signal.SIGINT)
+        for proc in children:
+            proc.wait()
+        return 130
+    except Exception:
+        _terminate_all()
+        raise
+
+
+if __name__ == "__main__":
+    sys.exit(main())

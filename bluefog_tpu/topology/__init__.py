@@ -26,6 +26,7 @@ from bluefog_tpu.topology.dynamic import (  # noqa: F401
     GetInnerOuterRingDynamicSendRecvRanks,
     GetInnerOuterExpo2DynamicSendRecvRanks,
     one_peer_round,
+    one_peer_dynamic_schedule,
     inner_outer_ring_round,
     inner_outer_expo2_round,
     exp2_machine_round,

@@ -25,6 +25,7 @@ __all__ = [
     "GetInnerOuterRingDynamicSendRecvRanks",
     "GetInnerOuterExpo2DynamicSendRecvRanks",
     "one_peer_round",
+    "one_peer_dynamic_schedule",
     "inner_outer_ring_round",
     "inner_outer_expo2_round",
     "exp2_machine_round",
@@ -52,6 +53,42 @@ def one_peer_round(topo: nx.DiGraph, index: int) -> Dict[int, int]:
         if succ:
             send[rank] = succ[index % len(succ)]
     return send
+
+
+def one_peer_dynamic_schedule(topo, rounds: int = None) -> list:
+    """The framework's headline dynamic mode, packaged: the full cycle of
+    one-peer rounds as ``DynamicTopology`` specs with the reference's
+    uniform combine weights 1/(in_degree+1) (reference
+    torch/mpi_ops.py:504-510).  Feed the result to
+    ``optim.functional.build_train_step(schedule=...)`` — the step index
+    picks the round via ``lax.switch``.
+
+    ``topo``: a DiGraph, or an int n for ExponentialTwoGraph(n) — BlueFog's
+    O(1)-communication-per-step graph (reference README.rst:51-60).
+    """
+    from bluefog_tpu.topology.graphs import ExponentialTwoGraph
+    from bluefog_tpu.topology.spec import DynamicTopology
+
+    if isinstance(topo, int):
+        topo = ExponentialTwoGraph(topo)
+    n = topo.number_of_nodes()
+    if rounds is None:
+        rounds = max(1, max(
+            len(s) for s in _clockwise_successors(topo)) if n > 1 else 1)
+    schedule = []
+    for i in range(rounds):
+        send = one_peer_round(topo, i)
+        recv: Dict[int, List[int]] = {r: [] for r in range(n)}
+        for src, dst in send.items():
+            recv[dst].append(src)
+        edge_weights, selfs = {}, []
+        for r in range(n):
+            w = 1.0 / (len(recv[r]) + 1)
+            selfs.append(w)
+            for src in recv[r]:
+                edge_weights[(src, r)] = w
+        schedule.append(DynamicTopology.from_edges(n, edge_weights, selfs))
+    return schedule
 
 
 def GetDynamicOnePeerSendRecvRanks(

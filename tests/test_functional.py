@@ -13,9 +13,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bluefog_tpu.optim import functional as F
 from bluefog_tpu.topology.graphs import ExponentialTwoGraph, RingGraph
-from bluefog_tpu.topology.spec import Topology
-from bluefog_tpu.topology.dynamic import GetDynamicOnePeerSendRecvRanks
-from bluefog_tpu.topology.spec import DynamicTopology
+from bluefog_tpu.topology.dynamic import one_peer_dynamic_schedule
 
 N = 8
 DIM = 4
@@ -74,21 +72,9 @@ def test_dynamic_schedule_consensus():
     """One-peer dynamic exp2 schedule via lax.switch: pure averaging (lr=0)
     must drive ranks to consensus."""
     mesh = _mesh()
-    graph = ExponentialTwoGraph(N)
-    gens = [GetDynamicOnePeerSendRecvRanks(graph, r) for r in range(N)]
     rounds = int(np.log2(N))
-    schedule = []
-    for _ in range(rounds):
-        edge_weights, selfs = {}, []
-        sends = []
-        for r in range(N):
-            s, recv = next(gens[r])
-            sends.append(s)
-            w = 1.0 / (len(recv) + 1)
-            selfs.append(w)
-            for j in recv:
-                edge_weights[(j, r)] = w
-        schedule.append(DynamicTopology.from_edges(N, edge_weights, selfs))
+    schedule = one_peer_dynamic_schedule(N)
+    assert len(schedule) == rounds
 
     step_fn = F.build_train_step(
         loss_fn, optax.sgd(0.0), mesh, comm_mode="cta", schedule=schedule)
