@@ -1,0 +1,126 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's native layer bridges framework tensors to MPI/NCCL; on TPU
+XLA supplies the data plane, so the native components here are the runtime
+pieces AROUND the compute path (SURVEY.md §7.9): currently the Chrome-
+tracing timeline writer (lock-free SPSC ring + writer thread, mirroring
+reference common/timeline.{h,cc}).
+
+The shared library is built lazily with g++ on first use and cached next to
+the source; every consumer must degrade gracefully when ``available()`` is
+False (no compiler, exotic platform).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "bf_native.cc")
+_LIB = os.path.join(_HERE, "libbf_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    # per-process temp name: concurrent ranks (bfrun) may build at once and
+    # must not clobber each other's output mid-write
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", tmp,
+           _SRC, "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120,
+                       text=True)
+        os.replace(tmp, _LIB)
+        return True
+    except subprocess.CalledProcessError as exc:
+        _log_build_failure(exc.stderr)
+        return False
+    except (OSError, subprocess.SubprocessError) as exc:
+        _log_build_failure(str(exc))
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _log_build_failure(detail: str):
+    from bluefog_tpu.logging_util import get_logger
+
+    get_logger().warning(
+        "native library build failed; falling back to Python "
+        "implementations. Compiler output:\n%s", detail)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        stale = (not os.path.exists(_LIB) or
+                 os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        if stale and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.bf_timeline_open.restype = ctypes.c_void_p
+        lib.bf_timeline_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.bf_timeline_record.restype = None
+        lib.bf_timeline_record.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char]
+        lib.bf_timeline_dropped.restype = ctypes.c_longlong
+        lib.bf_timeline_dropped.argtypes = [ctypes.c_void_p]
+        lib.bf_timeline_close.restype = None
+        lib.bf_timeline_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeTimelineWriter:
+    """ctypes facade over the C++ TimelineWriter.  Single-producer: callers
+    must serialize Record calls (the Python Timeline holds a lock)."""
+
+    def __init__(self, path: str, rank: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._dropped_at_close = 0
+        self._handle = lib.bf_timeline_open(path.encode(), rank)
+        if not self._handle:
+            raise OSError(f"cannot open timeline file {path}")
+
+    def record(self, name: str, tid: str, phase: str):
+        self._lib.bf_timeline_record(
+            self._handle, name.encode(), tid.encode(), phase.encode())
+
+    def dropped(self) -> int:
+        if not self._handle:
+            return self._dropped_at_close
+        return int(self._lib.bf_timeline_dropped(self._handle))
+
+    def close(self):
+        if self._handle:
+            self._dropped_at_close = int(
+                self._lib.bf_timeline_dropped(self._handle))
+            self._lib.bf_timeline_close(self._handle)
+            self._handle = None

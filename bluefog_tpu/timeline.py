@@ -8,9 +8,14 @@ this module records the *framework-level* activity spans (enqueue, compute,
 update phases) with the same file format so the reference's timeline
 tooling (chrome://tracing) works unchanged.
 
-Events are handed to a background writer thread over a queue, like the
-reference's lock-free SPSC design (timeline.h:65-67) — the Python GIL makes
-a queue.Queue equivalent.
+Two writer backends:
+
+* **native** (default when buildable) — the C++ lock-free SPSC ring +
+  writer thread in ``bluefog_tpu/native/bf_native.cc``, the direct
+  equivalent of the reference's boost::lockfree design (timeline.h:65-67).
+* **python** — a queue.Queue + thread fallback, always available.
+
+Set ``BLUEFOG_TIMELINE_NATIVE=0`` to force the Python backend.
 """
 
 from __future__ import annotations
@@ -27,20 +32,20 @@ from typing import Optional
 __all__ = ["Timeline", "get_timeline", "start_timeline", "stop_timeline"]
 
 
-class Timeline:
-    def __init__(self, path: str, rank: int = 0):
-        self.path = f"{path}{rank}.json"
+class _PyWriter:
+    """Fallback writer: queue.Queue + daemon thread (GIL stands in for the
+    native ring's memory ordering)."""
+
+    def __init__(self, path: str, rank: int):
         self.rank = rank
         self._t0 = time.perf_counter()
         self._queue: "queue.Queue" = queue.Queue()
-        self._file = open(self.path, "w")
+        self._file = open(path, "w")
         self._file.write("[\n")
         self._first = True
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._writer, daemon=True)
         self._thread.start()
-        self._open_spans = {}
-        atexit.register(self.close)
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
@@ -57,48 +62,20 @@ class Timeline:
             self._file.write(json.dumps(event))
             self._file.flush()
 
-    def start_activity(self, tensor_name: str, activity: str):
-        self._open_spans.setdefault(tensor_name, []).append(activity)
-        self._queue.put({
-            "name": activity,
-            "cat": tensor_name,
-            "ph": "B",
-            "ts": self._now_us(),
-            "pid": self.rank,
-            "tid": tensor_name,
-        })
+    def record(self, name: str, tid: str, phase: str):
+        ts = self._now_us()
+        if phase == "B":
+            self._queue.put({"name": name, "cat": tid, "ph": "B", "ts": ts,
+                             "pid": self.rank, "tid": tid})
+        elif phase == "E":
+            self._queue.put({"ph": "E", "ts": ts, "pid": self.rank,
+                             "tid": tid})
+        else:
+            self._queue.put({"name": name, "ph": "i", "ts": ts,
+                             "pid": self.rank, "s": "p"})
 
-    def end_activity(self, tensor_name: str):
-        spans = self._open_spans.get(tensor_name)
-        if spans:
-            spans.pop()
-        self._queue.put({
-            "ph": "E",
-            "ts": self._now_us(),
-            "pid": self.rank,
-            "tid": tensor_name,
-        })
-
-    def instant(self, name: str):
-        self._queue.put({
-            "name": name,
-            "ph": "i",
-            "ts": self._now_us(),
-            "pid": self.rank,
-            "s": "p",
-        })
-
-    def activity(self, name: str):
-        """One-shot marker used by the eager op layer."""
-        self.instant(name)
-
-    @contextmanager
-    def context(self, tensor_name: str, activity: str):
-        self.start_activity(tensor_name, activity)
-        try:
-            yield
-        finally:
-            self.end_activity(tensor_name)
+    def dropped(self) -> int:
+        return 0
 
     def close(self):
         if self._stop.is_set():
@@ -110,6 +87,75 @@ class Timeline:
             self._file.close()
         except ValueError:
             pass
+
+
+def _make_writer(path: str, rank: int, use_native: Optional[bool]):
+    if use_native is None:
+        use_native = os.environ.get("BLUEFOG_TIMELINE_NATIVE", "1") != "0"
+    if use_native:
+        try:
+            from bluefog_tpu import native
+
+            if native.available():
+                return native.NativeTimelineWriter(path, rank), "native"
+        except (ImportError, OSError, RuntimeError) as exc:
+            from bluefog_tpu.logging_util import get_logger
+
+            get_logger().warning(
+                "native timeline writer unavailable (%s); using the Python "
+                "backend", exc)
+    return _PyWriter(path, rank), "python"
+
+
+class Timeline:
+    def __init__(self, path: str, rank: int = 0,
+                 use_native: Optional[bool] = None):
+        self.path = f"{path}{rank}.json"
+        self.rank = rank
+        self._writer, self.backend = _make_writer(self.path, rank, use_native)
+        self._lock = threading.Lock()  # writers are single-producer
+        self._open_spans = {}
+        self._closed = False
+        atexit.register(self.close)
+
+    def start_activity(self, tensor_name: str, activity: str):
+        with self._lock:
+            self._open_spans.setdefault(tensor_name, []).append(activity)
+            self._writer.record(activity, tensor_name, "B")
+
+    def end_activity(self, tensor_name: str):
+        with self._lock:
+            spans = self._open_spans.get(tensor_name)
+            if spans:
+                spans.pop()
+            self._writer.record("", tensor_name, "E")
+
+    def instant(self, name: str):
+        with self._lock:
+            self._writer.record(name, "", "i")
+
+    def activity(self, name: str):
+        """One-shot marker used by the eager op layer."""
+        self.instant(name)
+
+    def dropped_events(self) -> int:
+        with self._lock:
+            return self._writer.dropped()
+
+    @contextmanager
+    def context(self, tensor_name: str, activity: str):
+        self.start_activity(tensor_name, activity)
+        try:
+            yield
+        finally:
+            self.end_activity(tensor_name)
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._writer.close()
 
 
 _timeline: Optional[Timeline] = None

@@ -1,0 +1,95 @@
+"""Gradient compression (reference compressor/ prototype parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bluefog_tpu.compressor import (
+    CompressedOptimizer,
+    QuantizedCompressor,
+    RandomKCompressor,
+    TopKCompressor,
+    compress_gradients,
+)
+
+
+def test_topk_keeps_largest():
+    x = jnp.asarray([[0.1, -5.0, 0.3], [2.0, -0.2, 0.05]])
+    out = TopKCompressor(k=2)(x)
+    expected = np.zeros((2, 3))
+    expected[0, 1] = -5.0
+    expected[1, 0] = 2.0
+    np.testing.assert_array_equal(np.asarray(out), expected)
+
+
+def test_topk_percentage():
+    x = jnp.arange(100.0)
+    out = TopKCompressor(percentage=0.1)(x)
+    assert int((np.asarray(out) != 0).sum()) == 10
+    assert np.asarray(out)[-10:].tolist() == list(range(90, 100))
+
+
+def test_topk_arg_validation():
+    with pytest.raises(ValueError):
+        TopKCompressor()
+    with pytest.raises(ValueError):
+        TopKCompressor(k=3, percentage=0.5)
+    with pytest.raises(ValueError):
+        TopKCompressor(percentage=1.5)
+
+
+def test_randomk_count_and_subset():
+    x = jnp.arange(1.0, 101.0)
+    out = RandomKCompressor(k=7)(x, key=jax.random.PRNGKey(0))
+    nz = np.asarray(out) != 0
+    assert nz.sum() == 7
+    np.testing.assert_array_equal(np.asarray(out)[nz], np.asarray(x)[nz])
+
+
+def test_quantized_unbiased():
+    """Stochastic quantization is (approximately) unbiased."""
+    x = jnp.asarray(np.random.RandomState(0).randn(1000))
+    comp = QuantizedCompressor(s=8)
+    outs = np.stack([
+        np.asarray(comp(x, key=jax.random.PRNGKey(i))) for i in range(200)
+    ])
+    np.testing.assert_allclose(outs.mean(axis=0), np.asarray(x), atol=0.05)
+
+
+def test_quantized_zero_input():
+    out = QuantizedCompressor(s=4)(jnp.zeros(8), key=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.zeros(8))
+
+
+def test_compressed_optimizer_converges():
+    """TopK-compressed SGD still solves least squares (jit-compiled)."""
+    rng = np.random.RandomState(0)
+    A = jnp.asarray(rng.randn(64, 8))
+    x_true = rng.randn(8)
+    b = jnp.asarray(A @ x_true)
+    opt = CompressedOptimizer(optax.sgd(0.05), TopKCompressor(k=4))
+    params = jnp.zeros(8)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        grads = jax.grad(lambda p: jnp.mean((A @ p - b) ** 2))(params)
+        updates, state = opt.update(grads, state)
+        return optax.apply_updates(params, updates), state
+
+    for _ in range(500):
+        params, state = step(params, state)
+    assert float(jnp.abs(params - x_true).max()) < 0.05
+
+
+def test_compress_gradients_key_rotation():
+    """RandomK picks different coordinates on successive steps."""
+    t = compress_gradients(RandomKCompressor(k=3), seed=1)
+    g = {"w": jnp.arange(1.0, 21.0)}
+    state = t.init(g)
+    u1, state = t.update(g, state)
+    u2, state = t.update(g, state)
+    assert not np.array_equal(np.asarray(u1["w"]) != 0,
+                              np.asarray(u2["w"]) != 0)
