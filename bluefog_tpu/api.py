@@ -582,7 +582,8 @@ def win_unlock(name: str):
 
 def win_fence(name: str):
     _wm().window(name)
-    jax.block_until_ready(_wm().window(name).mailbox)
+    with ctx_mod._watchdog.watch(f"win_fence.{name}"):
+        jax.block_until_ready(_wm().window(name).mailbox)
 
 
 def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:

@@ -16,6 +16,7 @@ __all__ = [
     "timeline_path",
     "skip_negotiate_default",
     "ops_on_cpu",
+    "stall_warning_time",
 ]
 
 
@@ -45,6 +46,16 @@ def skip_negotiate_default() -> bool:
     the flag is kept so scripts that set it keep working
     (reference operations.cc:1149-1183)."""
     return _env("BLUEFOG_SKIP_NEGOTIATE_STAGE", "0") in ("1", "true", "True")
+
+
+def stall_warning_time() -> float:
+    """BLUEFOG_STALL_WARNING_TIME (seconds, default 60; <=0 disables) — how
+    long a blocking wait may run before the stall watchdog logs a warning
+    (reference STALL_WARNING_TIME operations.cc:47, watchdog :388-433)."""
+    try:
+        return float(_env("BLUEFOG_STALL_WARNING_TIME", "60"))
+    except ValueError:
+        return 60.0
 
 
 def ops_on_cpu() -> bool:

@@ -45,6 +45,75 @@ class BluefogError(RuntimeError):
     pass
 
 
+class StallWatchdog:
+    """Warns when a blocking wait runs longer than
+    BLUEFOG_STALL_WARNING_TIME (reference stall watchdog: rank 0 prints
+    tensors waiting >60 s, operations.cc:388-433).  One scanning thread for
+    the whole process; waits register/unregister in a dict, so the per-op
+    cost is a lock + dict write."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._waits: Dict[int, Tuple[str, float, int]] = {}
+        self._next = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+    def _loop(self):
+        import time
+
+        while not self._stop.wait(min(5.0, max(0.05, bfconfig.stall_warning_time() / 4))):
+            threshold = bfconfig.stall_warning_time()
+            if threshold <= 0:
+                continue
+            now = time.monotonic()
+            with self._lock:
+                items = list(self._waits.items())
+                for token, (name, start, warned) in items:
+                    elapsed = now - start
+                    if elapsed > threshold * (warned + 1):
+                        logger.warning(
+                            "Stall detected: op '%s' has been waiting for "
+                            "%.1f s. One or more processes/devices may be "
+                            "stuck or dead (reference operations.cc:388-433).",
+                            name, elapsed)
+                        self._waits[token] = (name, start, warned + 1)
+
+    def watch(self, name: str):
+        from contextlib import contextmanager
+
+        @contextmanager
+        def ctx():
+            import time
+
+            if bfconfig.stall_warning_time() <= 0:
+                yield
+                return
+            with self._lock:
+                token = self._next
+                self._next += 1
+                self._waits[token] = (name, time.monotonic(), 0)
+            self._ensure_thread()
+            try:
+                yield
+            finally:
+                with self._lock:
+                    self._waits.pop(token, None)
+
+        return ctx()
+
+
+_watchdog = StallWatchdog()
+
+
 def host_fetch(array) -> np.ndarray:
     """Materialize a (possibly multi-host-sharded) array on this host.
 
@@ -378,7 +447,8 @@ class BluefogContext:
                 raise BluefogError(f"Unknown handle {handle}")
             key, value = self._handle_map.pop(handle)
             self._inflight_names.discard(key)
-        return jax.block_until_ready(value)
+        with _watchdog.watch(key):
+            return jax.block_until_ready(value)
 
     def poll(self, handle: int) -> bool:
         with self._handle_lock:
@@ -395,7 +465,8 @@ class BluefogContext:
         Reference: mpi_controller.cc:1185 / mpi_ops.py:1002-1005."""
         token = self.run_op(("barrier",), lambda x: C.allreduce(x, AXIS, False),
                             np.zeros((self._size, 1), np.int32))
-        jax.block_until_ready(token)
+        with _watchdog.watch("barrier"):
+            jax.block_until_ready(token)
 
     # ------------------------------------------------------------------ #
     # weight resolution for neighbor ops

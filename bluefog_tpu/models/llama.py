@@ -42,6 +42,8 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
     attn_mode: str = "full"  # full | blockwise | ring
+    attn_impl: str = "xla"  # xla | flash (Pallas kernel; ring+flash is
+    #                         forward-only — see parallel/ring_attention.py)
     attn_block_size: int = 512  # for blockwise mode
     sp_axis: Optional[str] = None  # mesh axis for ring mode
     remat: bool = False
@@ -117,7 +119,14 @@ class Attention(nn.Module):
         k = rotary_embed(k, positions, cfg.rope_theta)
         if cfg.attn_mode == "ring":
             assert cfg.sp_axis is not None, "ring attention needs sp_axis"
-            out = ring_attention(q, k, v, cfg.sp_axis, causal=True)
+            out = ring_attention(q, k, v, cfg.sp_axis, causal=True,
+                                 impl=cfg.attn_impl)
+        elif cfg.attn_impl == "flash":
+            from bluefog_tpu.parallel.pallas_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=True,
+                                  block_q=min(cfg.attn_block_size, t),
+                                  block_k=min(cfg.attn_block_size, t))
         elif cfg.attn_mode == "blockwise":
             out = blockwise_attention(q, k, v, cfg.attn_block_size, causal=True)
         else:

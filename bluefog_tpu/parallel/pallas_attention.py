@@ -1,0 +1,243 @@
+"""Pallas TPU flash-attention kernel.
+
+The reference's only custom kernel is a CUDA buffer-scale
+(reference bluefog/common/cuda/cuda_kernels.cu); SURVEY.md §7.9 calls for
+Pallas kernels where the TPU build needs custom compute.  Attention is the
+hot op of the Llama stress config, so this is the first one: a blockwise
+online-softmax (flash) kernel that keeps the score matrix in VMEM, streams
+K/V blocks, and optionally returns the log-sum-exp residual so callers can
+merge partial attentions — exactly what ring attention needs per ring step.
+
+Design:
+* grid = (batch*heads, query blocks); per instance the q block lives in
+  VMEM, K/V stream as [T_k, D] slices; scores/accumulator in f32.
+* GQA without widening: the K/V BlockSpec index map folds query head h to
+  kv head h // (H/H_kv) — no repeated K/V in HBM or VMEM.
+* global position offsets arrive as SMEM scalars, so the same compiled
+  kernel serves every ring step (offsets are traced values).
+* backward = recomputation against the pure-jnp reference via custom_vjp
+  (a fused backward kernel is future work; forward is where the VMEM
+  pressure and HBM traffic are).
+
+Interpret mode (CPU tests) is selected automatically off the backend.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "flash_attention_with_lse"]
+
+_NEG_INF = -1e30
+
+
+def _kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+            block_k: int, causal: bool, scale: float):
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    block_q, d = q.shape
+    t_k = k_ref.shape[1]
+    n_k = t_k // block_k
+    qi = pl.program_id(1)
+    q_pos = (q_off_ref[0] + qi * block_q +
+             jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(kj, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            kv_pos = (kv_off_ref[0] + kj * block_k +
+                      jax.lax.broadcasted_iota(
+                          jnp.int32, (block_q, block_k), 1))
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        blk_m = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, blk_m)
+        p = jnp.exp(s - new_m[:, None])
+        if causal:
+            # fully-masked rows have s == new_m == _NEG_INF, where the
+            # subtraction would give exp(0) = 1; zero them explicitly
+            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m - new_m)
+        new_l = l * corr + jnp.sum(p, axis=-1)
+        new_acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return new_m, new_l, new_acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    safe_l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    # lse = m + log(l); fully-masked rows stay at ~_NEG_INF
+    lse_ref[0, :, 0] = jnp.where(l > 0, m + jnp.log(safe_l), _NEG_INF)
+
+
+def _fit_block(t: int, want: int) -> int:
+    """Largest divisor of ``t`` that is <= ``want`` — block sizes must tile
+    the sequence exactly (no tail handling in the kernel)."""
+    want = min(want, t)
+    for b in range(want, 0, -1):
+        if t % b == 0:
+            return b
+    return 1
+
+
+def _flash_fwd_impl(q, k, v, q_offset, kv_offset, *, causal, scale,
+                    block_q, block_k, interpret):
+    b, t_q, h, d = q.shape
+    h_kv = k.shape[2]
+    t_k = k.shape[1]
+    group = h // h_kv
+    block_q = _fit_block(t_q, block_q)
+    block_k = _fit_block(t_k, block_k)
+
+    # [B, T, H, D] -> [B*H, T, D] (kv keeps its narrow head count)
+    qt = jnp.moveaxis(q, 2, 1).reshape(b * h, t_q, d)
+    kt = jnp.moveaxis(k, 2, 1).reshape(b * h_kv, t_k, d)
+    vt = jnp.moveaxis(v, 2, 1).reshape(b * h_kv, t_k, d)
+    q_off = jnp.reshape(jnp.asarray(q_offset, jnp.int32), (1,))
+    kv_off = jnp.reshape(jnp.asarray(kv_offset, jnp.int32), (1,))
+
+    def kv_index(bh, qi):
+        # query row bh = batch*H + head  ->  kv row batch*H_kv + head//group
+        return (bh // h * h_kv + (bh % h) // group, 0, 0)
+
+    grid = (b * h, t_q // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_kernel, block_k=block_k, causal=causal,
+                          scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t_k, d), kv_index),
+            pl.BlockSpec((1, t_k, d), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            # trailing singleton keeps the block TPU-tileable (last dim
+            # equals the array dim; second-to-last is the 8-aligned block_q)
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q_off, kv_off, qt, kt, vt)
+    out = jnp.moveaxis(out.reshape(b, h, t_q, d), 1, 2)
+    lse = lse.reshape(b, h, t_q)
+    return out, lse
+
+
+def _reference(q, k, v, q_offset, kv_offset, causal, scale):
+    """Pure-jnp twin used for the backward pass (recomputation)."""
+    h, h_kv = q.shape[2], k.shape[2]
+    if h_kv != h:
+        k = jnp.repeat(k, h // h_kv, axis=2)
+        v = jnp.repeat(v, h // h_kv, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(t_q)
+        kv_pos = kv_offset + jnp.arange(t_k)
+        s = jnp.where((q_pos[:, None] >= kv_pos[None, :])[None, None],
+                      s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.maximum(l, 1e-30),
+                     v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, q_offset, kv_offset, causal, scale, block_q, block_k,
+           interpret):
+    out, _ = _flash_fwd_impl(q, k, v, q_offset, kv_offset, causal=causal,
+                             scale=scale, block_q=block_q, block_k=block_k,
+                             interpret=interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, q_offset, kv_offset, causal, scale, block_q, block_k,
+               interpret):
+    out, _ = _flash_fwd_impl(q, k, v, q_offset, kv_offset, causal=causal,
+                             scale=scale, block_q=block_q, block_k=block_k,
+                             interpret=interpret)
+    return out, (q, k, v, q_offset, kv_offset)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, q_offset, kv_offset = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _reference(q, k, v, q_offset, kv_offset, causal,
+                                   scale), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _auto_interpret(interpret: Optional[bool]) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention.  q: [B, T_q, H, D]; k/v: [B, T_k, H_kv, D] (GQA
+    served by index mapping, never materialized).  Differentiable
+    (recompute-based backward)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash(q, k, v, q_offset, kv_offset, causal, scale, block_q,
+                  block_k, _auto_interpret(interpret))
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Forward-only variant returning (out, lse) with
+    lse[b, h, t] = logsumexp of that row's masked scores — the residual
+    needed to merge partial attentions across ring steps."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_fwd_impl(q, k, v, q_offset, kv_offset, causal=causal,
+                           scale=scale, block_q=block_q, block_k=block_k,
+                           interpret=_auto_interpret(interpret))

@@ -42,6 +42,9 @@ def _merge_block(carry_m, carry_l, carry_acc, scores, v):
     new_m = jnp.maximum(carry_m, block_m)
     correction = jnp.exp(carry_m - new_m)
     p = jnp.exp(scores - new_m[..., None])  # [B, H, Tq, Tk]
+    # fully-masked rows (scores == new_m == -1e30) must contribute 0, not
+    # exp(0) = 1
+    p = jnp.where(scores <= _NEG_INF / 2, 0.0, p)
     new_l = carry_l * correction + jnp.sum(p, axis=-1)
     pv = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
     new_acc = carry_acc * correction[..., None] + pv
@@ -82,6 +85,7 @@ def ring_attention(
     axis_name: str,
     causal: bool = True,
     scale: Optional[float] = None,
+    impl: str = "xla",
 ) -> jax.Array:
     """Blockwise attention with K/V rotating around the mesh-axis ring.
 
@@ -91,12 +95,21 @@ def ring_attention(
     At ring step s, this device holds the K/V block that originated on rank
     ``(idx - s) mod n``; after the local merge the block moves to rank
     ``idx + 1``.  n steps cover the full sequence.
+
+    impl="flash" runs each per-step block attention as the Pallas flash
+    kernel (bluefog_tpu.parallel.pallas_attention) and merges partial
+    outputs via their log-sum-exp residuals.  Forward-only for now (the
+    Pallas path has no ring-level VJP); use the default "xla" impl for
+    training.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, t_local, n_heads, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    if impl == "flash":
+        return _ring_flash(q, k, v, idx, axis_name, causal, scale, n,
+                           t_local)
 
     q_offset = idx * t_local
     m0 = jnp.full((b, n_heads, t_local), _NEG_INF, jnp.float32)
@@ -131,6 +144,64 @@ def ring_attention(
     # but guard anyway) divide by max(l, tiny).
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, idx, axis_name, causal, scale, n, t_local):
+    """Ring attention over the Pallas flash kernel: per step the kernel
+    returns (out_s, lse_s); partials merge with logsumexp weights, so the
+    full softmax is exact.  custom_vjp wraps the WHOLE ring (not just the
+    output): differentiation must never trace into the Pallas call — its
+    jvp rule fails with an opaque assertion — so the bwd raises a clear
+    NotImplementedError instead."""
+    from bluefog_tpu.parallel.pallas_attention import flash_attention_with_lse
+
+    q_offset = idx * t_local
+    shift = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(s, k_blk, v_blk, o, lse):
+        kv_offset = ((idx - s) % n) * t_local
+        o_s, lse_s = flash_attention_with_lse(
+            q, k_blk, v_blk, causal=causal, scale=scale,
+            q_offset=q_offset, kv_offset=kv_offset)
+        new_lse = jnp.logaddexp(lse, lse_s)  # [B, H, T]
+        w_old = jnp.exp(lse - new_lse)
+        w_new = jnp.exp(lse_s - new_lse)
+        # weights come as [B, H, T]; outputs are [B, T, H, D]
+        o = (o * jnp.moveaxis(w_old, 1, 2)[..., None] +
+             o_s.astype(jnp.float32) * jnp.moveaxis(w_new, 1, 2)[..., None])
+        return o, new_lse
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full((q.shape[0], q.shape[2], t_local), _NEG_INF, jnp.float32)
+    o, lse = step(0, k, v, o0, lse0)
+
+    def body(carry, s):
+        k_blk, v_blk, o, lse = carry
+        k_blk = lax.ppermute(k_blk, axis_name, shift)
+        v_blk = lax.ppermute(v_blk, axis_name, shift)
+        o, lse = step(s, k_blk, v_blk, o, lse)
+        return (k_blk, v_blk, o, lse), None
+
+    (_, _, o, _), _ = lax.scan(body, (k, v, o, lse), jnp.arange(1, n))
+    return o.astype(q.dtype)
+
+
+def _ring_flash_fwd(q, k, v, idx, axis_name, causal, scale, n, t_local):
+    return _ring_flash(q, k, v, idx, axis_name, causal, scale, n,
+                       t_local), None
+
+
+def _ring_flash_bwd(axis_name, causal, scale, n, t_local, res, g):
+    raise NotImplementedError(
+        "ring_attention(impl='flash') is forward-only — the Pallas path has "
+        "no ring-level VJP yet. Use impl='xla' for training.")
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def blockwise_attention(

@@ -138,11 +138,14 @@ class WindowManager:
             return handle
 
     def wait(self, handle: int) -> bool:
+        from bluefog_tpu.context import _watchdog
+
         with self._lock:
             entry = self._win_handle_map.pop(handle, None)
         if entry is None:
             return False
-        jax.block_until_ready(entry[1])
+        with _watchdog.watch(f"win.{entry[0]}"):
+            jax.block_until_ready(entry[1])
         return True
 
     def poll(self, handle: int) -> bool:

@@ -1,0 +1,52 @@
+"""Stall watchdog (reference operations.cc:388-433 parity)."""
+
+import logging
+import time
+
+import pytest
+
+from bluefog_tpu.context import StallWatchdog
+from bluefog_tpu.logging_util import get_logger
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.WARNING)
+        self.messages = []
+
+    def emit(self, record):
+        self.messages.append(record.getMessage())
+
+
+@pytest.fixture
+def capture():
+    handler = _Capture()
+    logger = get_logger()
+    logger.addHandler(handler)
+    yield handler
+    logger.removeHandler(handler)
+
+
+def test_watchdog_warns_on_stall(monkeypatch, capture):
+    monkeypatch.setenv("BLUEFOG_STALL_WARNING_TIME", "0.2")
+    wd = StallWatchdog()
+    with wd.watch("allreduce.noname.0"):
+        time.sleep(0.8)
+    assert any("Stall detected" in m and "allreduce.noname.0" in m
+               for m in capture.messages)
+
+
+def test_watchdog_silent_on_fast_wait(monkeypatch, capture):
+    monkeypatch.setenv("BLUEFOG_STALL_WARNING_TIME", "5")
+    wd = StallWatchdog()
+    with wd.watch("fast_op"):
+        time.sleep(0.01)
+    assert not any("Stall detected" in m for m in capture.messages)
+
+
+def test_watchdog_disabled(monkeypatch, capture):
+    monkeypatch.setenv("BLUEFOG_STALL_WARNING_TIME", "0")
+    wd = StallWatchdog()
+    with wd.watch("op"):
+        time.sleep(0.1)
+    assert not any("Stall detected" in m for m in capture.messages)
