@@ -67,6 +67,9 @@ class StallWatchdog:
                                                 daemon=True)
                 self._thread.start()
 
+    def stop(self):
+        self._stop.set()
+
     def _loop(self):
         import time
 
@@ -75,17 +78,20 @@ class StallWatchdog:
             if threshold <= 0:
                 continue
             now = time.monotonic()
+            stalled = []
             with self._lock:
-                items = list(self._waits.items())
-                for token, (name, start, warned) in items:
+                for token, (name, start, warned) in list(self._waits.items()):
                     elapsed = now - start
                     if elapsed > threshold * (warned + 1):
-                        logger.warning(
-                            "Stall detected: op '%s' has been waiting for "
-                            "%.1f s. One or more processes/devices may be "
-                            "stuck or dead (reference operations.cc:388-433).",
-                            name, elapsed)
+                        stalled.append((name, elapsed))
                         self._waits[token] = (name, start, warned + 1)
+            # log OUTSIDE the lock: a slow log handler must not block the
+            # register/unregister fast path of every wait
+            for name, elapsed in stalled:
+                logger.warning(
+                    "Stall detected: op '%s' has been waiting for %.1f s. "
+                    "One or more processes/devices may be stuck or dead "
+                    "(reference operations.cc:388-433).", name, elapsed)
 
     def watch(self, name: str):
         from contextlib import contextmanager

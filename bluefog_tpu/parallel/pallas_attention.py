@@ -37,51 +37,60 @@ __all__ = ["flash_attention", "flash_attention_with_lse"]
 _NEG_INF = -1e30
 
 
-def _kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-            block_k: int, causal: bool, scale: float):
-    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
-    block_q, d = q.shape
-    t_k = k_ref.shape[1]
-    n_k = t_k // block_k
+def _kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+            m_ref, l_ref, acc_ref, *, causal: bool, scale: float):
+    """Grid = (batch*heads, q blocks, k blocks).  Only one (block_q, D) Q
+    tile and one (block_k, D) K/V tile are resident in VMEM per instance —
+    long sequences never stage whole K/V on chip.  The online-softmax state
+    (m, l, acc) lives in VMEM scratch, which persists across the innermost
+    (k-block) grid dimension."""
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
     qi = pl.program_id(1)
-    q_pos = (q_off_ref[0] + qi * block_q +
-             jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+    q = q_ref[0].astype(jnp.float32)      # [block_q, D]
+    block_q, d = q.shape
+    k_blk = k_ref[0].astype(jnp.float32)  # [block_k, D]
+    v_blk = v_ref[0].astype(jnp.float32)
+    block_k = k_blk.shape[0]
 
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(kj == 0)
+    def _():
+        m_ref[:] = jnp.full((block_q,), _NEG_INF, jnp.float32)
+        l_ref[:] = jnp.zeros((block_q,), jnp.float32)
+        acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
 
-    def body(kj, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
-            kv_pos = (kv_off_ref[0] + kj * block_k +
-                      jax.lax.broadcasted_iota(
-                          jnp.int32, (block_q, block_k), 1))
-            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
-        blk_m = jnp.max(s, axis=-1)
-        new_m = jnp.maximum(m, blk_m)
-        p = jnp.exp(s - new_m[:, None])
-        if causal:
-            # fully-masked rows have s == new_m == _NEG_INF, where the
-            # subtraction would give exp(0) = 1; zero them explicitly
-            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
-        corr = jnp.exp(m - new_m)
-        new_l = l * corr + jnp.sum(p, axis=-1)
-        new_acc = acc * corr[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return new_m, new_l, new_acc
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [bq, bk]
+    if causal:
+        q_pos = (q_off_ref[0] + qi * block_q +
+                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+        kv_pos = (kv_off_ref[0] + kj * block_k +
+                  jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+        s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+    m, l, acc = m_ref[:], l_ref[:], acc_ref[:]
+    blk_m = jnp.max(s, axis=-1)
+    new_m = jnp.maximum(m, blk_m)
+    p = jnp.exp(s - new_m[:, None])
+    if causal:
+        # fully-masked rows have s == new_m == _NEG_INF, where the
+        # subtraction would give exp(0) = 1; zero them explicitly
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+    corr = jnp.exp(m - new_m)
+    m_ref[:] = new_m
+    l_ref[:] = l * corr + jnp.sum(p, axis=-1)
+    acc_ref[:] = acc * corr[:, None] + jax.lax.dot_general(
+        p, v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
-    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
-    safe_l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
-    # lse = m + log(l); fully-masked rows stay at ~_NEG_INF
-    lse_ref[0, :, 0] = jnp.where(l > 0, m + jnp.log(safe_l), _NEG_INF)
+    @pl.when(kj == n_k - 1)
+    def _():
+        l_final = l_ref[:]
+        safe_l = jnp.maximum(l_final, 1e-30)
+        o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
+        # lse = m + log(l); fully-masked rows stay at ~_NEG_INF
+        lse_ref[0, :, 0] = jnp.where(l_final > 0,
+                                     m_ref[:] + jnp.log(safe_l), _NEG_INF)
 
 
 def _fit_block(t: int, want: int) -> int:
@@ -110,31 +119,35 @@ def _flash_fwd_impl(q, k, v, q_offset, kv_offset, *, causal, scale,
     q_off = jnp.reshape(jnp.asarray(q_offset, jnp.int32), (1,))
     kv_off = jnp.reshape(jnp.asarray(kv_offset, jnp.int32), (1,))
 
-    def kv_index(bh, qi):
+    def kv_index(bh, qi, kj):
         # query row bh = batch*H + head  ->  kv row batch*H_kv + head//group
-        return (bh // h * h_kv + (bh % h) // group, 0, 0)
+        return (bh // h * h_kv + (bh % h) // group, kj, 0)
 
-    grid = (b * h, t_q // block_q)
+    grid = (b * h, t_q // block_q, t_k // block_k)
     out, lse = pl.pallas_call(
-        functools.partial(_kernel, block_k=block_k, causal=causal,
-                          scale=scale),
+        functools.partial(_kernel, causal=causal, scale=scale),
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, t_k, d), kv_index),
-            pl.BlockSpec((1, t_k, d), kv_index),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
             # trailing singleton keeps the block TPU-tileable (last dim
             # equals the array dim; second-to-last is the 8-aligned block_q)
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, t_q, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),      # running max m
+            pltpu.VMEM((block_q,), jnp.float32),      # running denom l
+            pltpu.VMEM((block_q, d), jnp.float32),    # running numer acc
         ],
         interpret=interpret,
     )(q_off, kv_off, qt, kt, vt)
@@ -144,25 +157,13 @@ def _flash_fwd_impl(q, k, v, q_offset, kv_offset, *, causal, scale,
 
 
 def _reference(q, k, v, q_offset, kv_offset, causal, scale):
-    """Pure-jnp twin used for the backward pass (recomputation)."""
-    h, h_kv = q.shape[2], k.shape[2]
-    if h_kv != h:
-        k = jnp.repeat(k, h // h_kv, axis=2)
-        v = jnp.repeat(v, h // h_kv, axis=2)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    if causal:
-        t_q, t_k = q.shape[1], k.shape[1]
-        q_pos = q_offset + jnp.arange(t_q)
-        kv_pos = kv_offset + jnp.arange(t_k)
-        s = jnp.where((q_pos[:, None] >= kv_pos[None, :])[None, None],
-                      s, _NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.maximum(l, 1e-30),
-                     v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    """Pure-jnp twin used for the backward pass (recomputation) — the
+    shared offset-aware dense attention, so mask/numeric semantics cannot
+    drift between the Pallas forward and the recomputed backward."""
+    from bluefog_tpu.parallel.ring_attention import full_attention
+
+    return full_attention(q, k, v, causal=causal, scale=scale,
+                          q_offset=q_offset, kv_offset=kv_offset)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))

@@ -261,13 +261,22 @@ def full_attention(
     v: jax.Array,
     causal: bool = True,
     scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
 ) -> jax.Array:
-    """Dense reference attention (q/k/v: [B, T, H(,_kv), D])."""
+    """Dense reference attention (q/k/v: [B, T, H(,_kv), D]).  The offsets
+    place the blocks in global coordinates for the causal mask — the same
+    semantics the Pallas kernel implements (its backward pass recomputes
+    through this function)."""
     b, t, n_heads, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     k, v = _repeat_kv(k, v, n_heads)
-    scores = _block_scores(q, k, 0, 0, scale, causal)
-    p = jax.nn.softmax(scores, axis=-1)
+    scores = _block_scores(q, k, q_offset, kv_offset, scale, causal)
+    # jax.nn.softmax keeps XLA's fused softmax (an explicit exp/sum chain
+    # measured 12x slower on TPU); the row-level guard zeroes rows whose
+    # every key is masked (softmax would give uniform 1/T there)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.where(m <= _NEG_INF / 2, 0.0, jax.nn.softmax(scores, axis=-1))
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)

@@ -27,26 +27,30 @@ def capture():
     logger.removeHandler(handler)
 
 
-def test_watchdog_warns_on_stall(monkeypatch, capture):
-    monkeypatch.setenv("BLUEFOG_STALL_WARNING_TIME", "0.2")
+@pytest.fixture
+def watchdog():
     wd = StallWatchdog()
-    with wd.watch("allreduce.noname.0"):
+    yield wd
+    wd.stop()
+
+
+def test_watchdog_warns_on_stall(monkeypatch, capture, watchdog):
+    monkeypatch.setenv("BLUEFOG_STALL_WARNING_TIME", "0.2")
+    with watchdog.watch("allreduce.noname.0"):
         time.sleep(0.8)
     assert any("Stall detected" in m and "allreduce.noname.0" in m
                for m in capture.messages)
 
 
-def test_watchdog_silent_on_fast_wait(monkeypatch, capture):
+def test_watchdog_silent_on_fast_wait(monkeypatch, capture, watchdog):
     monkeypatch.setenv("BLUEFOG_STALL_WARNING_TIME", "5")
-    wd = StallWatchdog()
-    with wd.watch("fast_op"):
+    with watchdog.watch("fast_op"):
         time.sleep(0.01)
     assert not any("Stall detected" in m for m in capture.messages)
 
 
-def test_watchdog_disabled(monkeypatch, capture):
+def test_watchdog_disabled(monkeypatch, capture, watchdog):
     monkeypatch.setenv("BLUEFOG_STALL_WARNING_TIME", "0")
-    wd = StallWatchdog()
-    with wd.watch("op"):
+    with watchdog.watch("op"):
         time.sleep(0.1)
     assert not any("Stall detected" in m for m in capture.messages)
