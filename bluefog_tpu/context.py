@@ -68,7 +68,12 @@ class StallWatchdog:
                 self._thread.start()
 
     def stop(self):
+        """Stop the scanner thread (Event.set wakes it immediately) and join
+        it, so a later watch() reliably restarts a fresh one."""
         self._stop.set()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=2.0)
 
     def _loop(self):
         import time

@@ -1,0 +1,150 @@
+"""Llama decentralized-SGD throughput benchmark (tokens/sec).
+
+The BASELINE.json stress config: "Llama-3-8B decentralized SGD with
+neighbor_allreduce — stress ICI at LLM scale".  Runs the fully-jitted
+decentralized train step on a Llama model, synthetic tokens, bf16 compute,
+optional sequence parallelism (ring attention) and Pallas flash attention.
+
+  --model tiny|200m|1b|8b   (8b needs a pod slice; 200m fits one v5e chip)
+  --dist-optimizer neighbor_allreduce|dynamic|horovod|local
+  --sp N                    sequence-parallel ways (mesh becomes dp x sp)
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bluefog_tpu import models
+from bluefog_tpu.context import _uniform_topology_spec
+from bluefog_tpu.optim import functional as F
+from bluefog_tpu.topology import ExponentialTwoGraph, one_peer_dynamic_schedule
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--model", default="200m",
+                    choices=["tiny", "200m", "1b", "8b"])
+parser.add_argument("--batch-size", type=int, default=4)
+parser.add_argument("--seq-len", type=int, default=2048)
+parser.add_argument("--dist-optimizer", default="neighbor_allreduce",
+                    choices=["neighbor_allreduce", "dynamic", "horovod",
+                             "local"])
+parser.add_argument("--sp", type=int, default=1,
+                    help="sequence-parallel ways (ring attention)")
+parser.add_argument("--attn-impl", default="xla", choices=["xla", "flash"])
+parser.add_argument("--num-warmup", type=int, default=3)
+parser.add_argument("--num-steps", type=int, default=10)
+args = parser.parse_args()
+
+
+def make_config():
+    base = dict(remat=True)
+    if args.sp > 1:
+        if args.attn_impl == "flash":
+            raise SystemExit(
+                "--sp > 1 with --attn-impl flash is not supported for "
+                "training (ring+flash has no VJP); use --attn-impl xla")
+        base.update(attn_mode="ring", sp_axis="sp")
+    elif args.attn_impl == "flash":
+        base.update(attn_impl="flash")
+    if args.model == "tiny":
+        return models.LlamaConfig.tiny(**base)
+    if args.model == "200m":
+        return models.LlamaConfig(
+            vocab_size=32000, dim=1024, n_layers=12, n_heads=16,
+            n_kv_heads=4, hidden_dim=2816, max_seq_len=8192, **base)
+    if args.model == "1b":
+        return models.LlamaConfig(
+            vocab_size=32000, dim=2048, n_layers=16, n_heads=32,
+            n_kv_heads=8, hidden_dim=5632, max_seq_len=8192, **base)
+    return models.LlamaConfig.llama3_8b(**base)
+
+
+def main():
+    devices = jax.devices()
+    n_total = len(devices)
+    n_sp = args.sp
+    assert n_total % n_sp == 0, (n_total, n_sp)
+    n_dp = n_total // n_sp
+    mesh = Mesh(np.array(devices).reshape(n_dp, n_sp), ("bf", "sp"))
+    cfg = make_config()
+    model = models.Llama(cfg)
+    t_local = args.seq_len // n_sp
+
+    def loss_fn(params, batch):
+        inp, tgt = batch
+        offset = jax.lax.axis_index("sp") * t_local if n_sp > 1 else 0
+        logits = model.apply(params, inp, pos_offset=offset)
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, tgt))
+
+    topo_kwargs, comm_mode = {}, "none"
+    if n_dp > 1:
+        if args.dist_optimizer == "neighbor_allreduce":
+            topo_kwargs = dict(
+                topology=_uniform_topology_spec(ExponentialTwoGraph(n_dp)))
+            comm_mode = "atc"
+        elif args.dist_optimizer == "dynamic":
+            topo_kwargs = dict(schedule=one_peer_dynamic_schedule(n_dp))
+            comm_mode = "atc"
+        elif args.dist_optimizer == "horovod":
+            comm_mode = "gradient_allreduce"
+
+    opt = optax.sgd(1e-3, momentum=0.9)
+    batch_specs = P("bf", None, "sp") if n_sp > 1 else P("bf")
+    step_fn = F.build_train_step(
+        loss_fn, opt, mesh, comm_mode=comm_mode,
+        sp_axis="sp" if n_sp > 1 else None, batch_specs=batch_specs,
+        **topo_kwargs)
+
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, cfg.vocab_size,
+                      (n_dp, args.batch_size, args.seq_len + 1)).astype(np.int32)
+    sharding = NamedSharding(mesh, batch_specs)
+    batch = (jax.device_put(raw[:, :, :-1], sharding),
+             jax.device_put(raw[:, :, 1:], sharding))
+
+    init_tokens = jnp.zeros((args.batch_size, min(8, args.seq_len)), jnp.int32)
+    base = models.Llama(
+        models.LlamaConfig(**{**cfg.__dict__, "attn_mode": "full",
+                              "attn_impl": "xla", "sp_axis": None})).init(
+        jax.random.PRNGKey(0), init_tokens)
+    n_params = sum(x.size for x in jax.tree.leaves(base))
+    params = F.rank_major(base, mesh)
+    opt_state = F.rank_major(opt.init(base), mesh)
+
+    sync = lambda a: np.asarray(jax.device_get(a))
+    step = 0
+    loss = None
+    for _ in range(max(args.num_warmup, 1)):  # >=1: compile outside timing
+        params, opt_state, loss = step_fn(params, opt_state, batch,
+                                          jnp.int32(step))
+        step += 1
+    sync(loss)
+    t0 = time.perf_counter()
+    sync(loss)
+    rtt = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_steps):
+        params, opt_state, loss = step_fn(params, opt_state, batch,
+                                          jnp.int32(step))
+        step += 1
+    final_loss = float(sync(loss).mean())
+    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+    tokens = n_dp * args.batch_size * args.seq_len * args.num_steps
+    print(json.dumps({
+        "model": args.model, "params": n_params,
+        "optimizer": args.dist_optimizer, "mesh": f"{n_dp}dp x {n_sp}sp",
+        "attn": cfg.attn_mode + "/" + cfg.attn_impl,
+        "tokens_per_sec": round(tokens / dt, 1),
+        "loss": round(final_loss, 4), "chips": n_total,
+    }))
+
+
+if __name__ == "__main__":
+    main()

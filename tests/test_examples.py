@@ -63,6 +63,14 @@ def test_mnist(dist_opt):
     assert "train_acc" in out
 
 
+def test_llama_benchmark_tiny():
+    out = run_example(
+        "llama_benchmark.py", "--model", "tiny", "--batch-size", "2",
+        "--seq-len", "64", "--sp", "2", "--dist-optimizer", "dynamic",
+        "--num-warmup", "1", "--num-steps", "2", timeout=360)
+    assert "tokens_per_sec" in out
+
+
 def test_resnet_benchmark_tiny():
     out = run_example(
         "resnet_benchmark.py", "--model", "resnet18", "--batch-size", "4",
