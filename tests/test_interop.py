@@ -1,0 +1,47 @@
+"""Torch framework adapter (reference second-framework binding parity)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bluefog_tpu import interop  # noqa: E402
+
+
+def test_allreduce(bf_ctx):
+    n = bf_ctx.size()
+    x = torch.arange(n * 3, dtype=torch.float32).reshape(n, 3)
+    out = interop.allreduce(x, average=True)
+    assert isinstance(out, torch.Tensor)
+    expected = x.numpy().mean(axis=0)
+    for r in range(n):
+        np.testing.assert_allclose(out[r].numpy(), expected, rtol=1e-6)
+
+
+def test_broadcast(bf_ctx):
+    n = bf_ctx.size()
+    x = torch.arange(n * 2, dtype=torch.float64).reshape(n, 2)
+    out = interop.broadcast(x, root_rank=2)
+    for r in range(n):
+        np.testing.assert_array_equal(out[r].numpy(), x[2].numpy())
+
+
+def test_allgather(bf_ctx):
+    n = bf_ctx.size()
+    x = torch.arange(n * 2, dtype=torch.float32).reshape(n, 1, 2)
+    out = interop.allgather(x)
+    # every rank holds the concatenation of all ranks' slices
+    assert out.shape == (n, n, 2)
+
+
+def test_neighbor_allreduce_consensus(bf_ctx):
+    n = bf_ctx.size()
+    x = torch.tensor([[float(r)] * 4 for r in range(n)])
+    for _ in range(30):
+        x = interop.neighbor_allreduce(x)
+    np.testing.assert_allclose(x.numpy(), (n - 1) / 2, atol=1e-6)
+
+
+def test_type_error(bf_ctx):
+    with pytest.raises(TypeError):
+        interop.allreduce(np.zeros((8, 2)))
