@@ -24,7 +24,8 @@ REFERENCE_IMG_PER_SEC_PER_CHIP = 4310.6 / 16  # docs/performance.rst:15-23
 # per-chip throughput is the comparable metric.
 BATCH_PER_CHIP = 128
 WARMUP_STEPS = 5
-TIMED_STEPS = 30
+TIMED_STEPS = 10
+TIMED_WINDOWS = 3  # report the median window (tunnel hiccups skew means)
 
 
 def main():
@@ -34,9 +35,9 @@ def main():
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from bluefog_tpu import models
-    from bluefog_tpu.context import _uniform_topology_spec
+    from bluefog_tpu.benchutil import device_fetch, fetch_overhead
     from bluefog_tpu.optim import functional as F
-    from bluefog_tpu.topology.graphs import ExponentialTwoGraph
+    from bluefog_tpu.topology import ExponentialTwoGraph, uniform_topology_spec
 
     devices = jax.devices()
     n = len(devices)
@@ -54,7 +55,7 @@ def main():
         return loss, updates["batch_stats"]
 
     if n > 1:
-        topo = dict(topology=_uniform_topology_spec(ExponentialTwoGraph(n)))
+        topo = dict(topology=uniform_topology_spec(ExponentialTwoGraph(n)))
         comm_mode = "atc"
     else:
         topo = dict()
@@ -79,27 +80,29 @@ def main():
              jax.device_put(labels, sharding))
 
     # NOTE: jax.block_until_ready can be a no-op over remote-tunnel
-    # backends; a device_get of the scalar loss is the reliable sync.
-    sync = lambda a: np.asarray(jax.device_get(a))
-
+    # backends; a device_get of the scalar loss is the reliable sync, and
+    # fetch_overhead() measures the round trip to subtract (with a FRESH
+    # computation each probe — refetching a ready array hits its host
+    # cache and measures ~0).
     for i in range(WARMUP_STEPS):
         params, aux, opt_state, loss = step_fn(params, aux, opt_state, batch,
                                                jnp.int32(i))
-    sync(loss)
+    device_fetch(loss)
+    rtt = fetch_overhead()
 
-    # one round-trip of a ready scalar = the fetch overhead to subtract
-    t0 = time.perf_counter()
-    sync(loss)
-    rtt = time.perf_counter() - t0
+    rates = []
+    step = WARMUP_STEPS
+    for _ in range(TIMED_WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(TIMED_STEPS):
+            params, aux, opt_state, loss = step_fn(
+                params, aux, opt_state, batch, jnp.int32(step))
+            step += 1
+        device_fetch(loss)
+        dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+        rates.append(n * BATCH_PER_CHIP * TIMED_STEPS / dt)
 
-    t0 = time.perf_counter()
-    for i in range(TIMED_STEPS):
-        params, aux, opt_state, loss = step_fn(
-            params, aux, opt_state, batch, jnp.int32(WARMUP_STEPS + i))
-    sync(loss)
-    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
-
-    total_img_per_sec = n * BATCH_PER_CHIP * TIMED_STEPS / dt
+    total_img_per_sec = float(np.median(rates))
     per_chip = total_img_per_sec / n
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",

@@ -1,0 +1,49 @@
+"""Shared benchmark timing helpers.
+
+Tunneled TPU backends make ``jax.block_until_ready`` a no-op, so the only
+reliable device sync is fetching a value that depends on the computation.
+That fetch carries one host<->device round trip, which these helpers
+measure honestly: the overhead probe computes a FRESH value each time
+(``x + 1``), because re-fetching the same jax.Array hits its cached host
+copy and measures ~0.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+__all__ = ["device_fetch", "fetch_overhead", "timed"]
+
+
+def device_fetch(a) -> np.ndarray:
+    """Synchronize by materializing ``a`` on the host."""
+    return np.asarray(jax.device_get(a))
+
+
+def fetch_overhead(repeats: int = 3) -> float:
+    """Median wall time of dispatching + fetching a fresh trivial
+    computation — the per-sync overhead to subtract from timed loops."""
+    x = jax.device_put(np.zeros(1, np.float32))
+    y = x + 1.0
+    device_fetch(y)  # compile outside timing
+    times = []
+    for i in range(repeats):
+        t0 = time.perf_counter()
+        device_fetch(x + float(i + 2))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def timed(run_steps, sync_value_fn, overhead: float = None) -> float:
+    """Run ``run_steps()`` (which enqueues work), sync via
+    ``sync_value_fn()`` (returning a computation-dependent array), and
+    return wall seconds with the fetch overhead subtracted."""
+    if overhead is None:
+        overhead = fetch_overhead()
+    t0 = time.perf_counter()
+    run_steps()
+    device_fetch(sync_value_fn())
+    return max(time.perf_counter() - t0 - overhead, 1e-9)

@@ -4,7 +4,7 @@ The reference maintains a second framework binding beside torch (its
 TensorFlow custom ops + DistributedOptimizer, reference
 bluefog/tensorflow/).  The TPU build's second surface is a **PyTorch
 bridge**: torch tensors in, torch tensors out, with the JAX/XLA data plane
-underneath (zero-copy via dlpack where possible).
+underneath (host round-trip through numpy).
 """
 
 from bluefog_tpu.interop.torch_adapter import (  # noqa: F401

@@ -5,8 +5,8 @@ Capability parity with the reference's second-framework binding layer
 op surface — allreduce / broadcast / (neighbor_)allreduce — exposed to a
 framework other than the primary one).  Here the primary surface is JAX;
 this adapter accepts **rank-major torch tensors** (``[n_ranks, ...]``,
-CPU) and returns torch tensors, converting through dlpack when zero-copy
-is possible and numpy otherwise.
+CPU) and returns torch tensors, converting through numpy (one host copy
+each way).
 
 This is host-side interop for experimentation and porting — the tensors
 round-trip through the host, so the jitted JAX path remains the
@@ -34,9 +34,18 @@ def _require_torch():
 
 
 def _to_jax(tensor):
+    import jax
+
     _require_torch()
     if not isinstance(tensor, torch.Tensor):
         raise TypeError(f"expected a torch.Tensor, got {type(tensor)}")
+    if (tensor.dtype in (torch.float64, torch.int64)
+            and not jax.config.jax_enable_x64):
+        # Without x64, JAX would silently truncate to 32 bits and the
+        # round-trip back to the torch dtype would hide the damage.
+        raise TypeError(
+            f"{tensor.dtype} tensors need jax_enable_x64; enable it or "
+            "cast to a 32-bit dtype first")
     return bf.rank_sharded(np.asarray(tensor.detach().cpu().contiguous()))
 
 
@@ -64,12 +73,14 @@ def allgather(tensor, name: Optional[str] = None):
 
 
 def neighbor_allreduce(tensor, *, self_weight=None, src_weights=None,
-                       dst_weights=None, name: Optional[str] = None):
+                       dst_weights=None, enable_topo_check: bool = True,
+                       name: Optional[str] = None):
     return _to_torch(
         bf.neighbor_allreduce(_to_jax(tensor), self_weight=self_weight,
                               src_weights=src_weights,
                               dst_weights=dst_weights,
-                              enable_topo_check=False, name=name),
+                              enable_topo_check=enable_topo_check,
+                              name=name),
         like=tensor)
 
 

@@ -35,6 +35,7 @@ from bluefog_tpu.topology.spec import (  # noqa: F401
     Topology,
     DynamicTopology,
     ShiftClass,
+    uniform_topology_spec,
 )
 from bluefog_tpu.topology.infer import (  # noqa: F401
     InferSourceFromDestinationRanks,

@@ -21,7 +21,24 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import networkx as nx
 import numpy as np
 
-__all__ = ["ShiftClass", "Topology", "DynamicTopology"]
+__all__ = ["ShiftClass", "Topology", "DynamicTopology",
+           "uniform_topology_spec"]
+
+
+def uniform_topology_spec(graph: nx.DiGraph) -> "Topology":
+    """Resolve a graph to the reference's *unweighted* combine: every rank
+    uses 1/(in_degree+1) for itself and each in-neighbor
+    (reference torch/mpi_ops.py:504-510)."""
+    n = graph.number_of_nodes()
+    adj = nx.to_numpy_array(graph) != 0.0
+    np.fill_diagonal(adj, False)
+    weights = np.zeros((n, n))
+    for dst in range(n):
+        srcs = np.nonzero(adj[:, dst])[0]
+        w = 1.0 / (len(srcs) + 1)
+        weights[srcs, dst] = w
+        weights[dst, dst] = w
+    return Topology.from_weight_matrix(weights)
 
 
 @dataclasses.dataclass(frozen=True)

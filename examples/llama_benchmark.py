@@ -21,9 +21,13 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bluefog_tpu import models
-from bluefog_tpu.context import _uniform_topology_spec
+from bluefog_tpu.benchutil import device_fetch, fetch_overhead
 from bluefog_tpu.optim import functional as F
-from bluefog_tpu.topology import ExponentialTwoGraph, one_peer_dynamic_schedule
+from bluefog_tpu.topology import (
+    ExponentialTwoGraph,
+    one_peer_dynamic_schedule,
+    uniform_topology_spec,
+)
 
 parser = argparse.ArgumentParser()
 parser.add_argument("--model", default="200m",
@@ -87,7 +91,7 @@ def main():
     if n_dp > 1:
         if args.dist_optimizer == "neighbor_allreduce":
             topo_kwargs = dict(
-                topology=_uniform_topology_spec(ExponentialTwoGraph(n_dp)))
+                topology=uniform_topology_spec(ExponentialTwoGraph(n_dp)))
             comm_mode = "atc"
         elif args.dist_optimizer == "dynamic":
             topo_kwargs = dict(schedule=one_peer_dynamic_schedule(n_dp))
@@ -125,24 +129,20 @@ def main():
     n_params = sum(x.size for x in jax.tree.leaves(params)) // max(
         mesh.shape["bf"], 1)
 
-    sync = lambda a: np.asarray(jax.device_get(a))
     step = 0
-    loss = None
     for _ in range(max(args.num_warmup, 1)):  # >=1: compile outside timing
         params, opt_state, loss = step_fn(params, opt_state, batch,
                                           jnp.int32(step))
         step += 1
-    sync(loss)
-    t0 = time.perf_counter()
-    sync(loss)
-    rtt = time.perf_counter() - t0
+    device_fetch(loss)
+    rtt = fetch_overhead()
 
     t0 = time.perf_counter()
     for _ in range(args.num_steps):
         params, opt_state, loss = step_fn(params, opt_state, batch,
                                           jnp.int32(step))
         step += 1
-    final_loss = float(sync(loss).mean())
+    final_loss = float(device_fetch(loss).mean())
     dt = max(time.perf_counter() - t0 - rtt, 1e-9)
     tokens = n_dp * args.batch_size * args.seq_len * args.num_steps
     print(json.dumps({

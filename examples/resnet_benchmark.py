@@ -25,11 +25,12 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bluefog_tpu import models
-from bluefog_tpu.context import _uniform_topology_spec
+from bluefog_tpu.benchutil import device_fetch, fetch_overhead
 from bluefog_tpu.optim import functional as F
 from bluefog_tpu.topology import (
     ExponentialTwoGraph,
     one_peer_dynamic_schedule,
+    uniform_topology_spec,
 )
 
 parser = argparse.ArgumentParser()
@@ -70,7 +71,7 @@ def main():
     if n > 1:
         if args.dist_optimizer == "neighbor_allreduce":
             topo_kwargs = dict(
-                topology=_uniform_topology_spec(ExponentialTwoGraph(n)))
+                topology=uniform_topology_spec(ExponentialTwoGraph(n)))
             comm_mode = "atc"
         elif args.dist_optimizer == "dynamic":
             topo_kwargs = dict(schedule=one_peer_dynamic_schedule(n))
@@ -99,13 +100,13 @@ def main():
             np.int32), sharding),
     )
 
-    sync = lambda a: np.asarray(jax.device_get(a))
     step = 0
-    for _ in range(args.num_warmup_batches):
+    for _ in range(max(args.num_warmup_batches, 1)):
         params, aux, opt_state, loss = step_fn(params, aux, opt_state, batch,
                                                jnp.int32(step))
         step += 1
-    sync(loss)
+    device_fetch(loss)
+    rtt = fetch_overhead()
 
     img_secs = []
     for it in range(args.num_iters):
@@ -114,8 +115,8 @@ def main():
             params, aux, opt_state, loss = step_fn(
                 params, aux, opt_state, batch, jnp.int32(step))
             step += 1
-        sync(loss)
-        dt = time.perf_counter() - t0
+        device_fetch(loss)
+        dt = max(time.perf_counter() - t0 - rtt, 1e-9)
         ips = n * args.batch_size * args.num_batches_per_iter / dt
         img_secs.append(ips)
         print(f"Iter #{it}: {ips:.1f} img/sec total ({n} chips)")

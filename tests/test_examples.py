@@ -63,6 +63,14 @@ def test_mnist(dist_opt):
     assert "train_acc" in out
 
 
+def test_scaling_benchmark_mlp():
+    out = run_example(
+        "scaling_benchmark.py", "--model", "mlp", "--batch-size", "16",
+        "--optimizers", "dynamic", "--num-warmup", "1", "--num-steps", "2",
+        timeout=360)
+    assert "efficiency" in out
+
+
 def test_llama_benchmark_tiny():
     out = run_example(
         "llama_benchmark.py", "--model", "tiny", "--batch-size", "2",
