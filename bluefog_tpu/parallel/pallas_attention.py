@@ -15,9 +15,10 @@ Design:
   kv head h // (H/H_kv) — no repeated K/V in HBM or VMEM.
 * global position offsets arrive as SMEM scalars, so the same compiled
   kernel serves every ring step (offsets are traced values).
-* backward = recomputation against the pure-jnp reference via custom_vjp
-  (a fused backward kernel is future work; forward is where the VMEM
-  pressure and HBM traffic are).
+* backward = two blockwise Pallas passes (dQ over K blocks; dK/dV over Q
+  blocks) using the saved (out, lse) residuals and the standard
+  delta = rowsum(dO * O) trick — no T x T matrix ever materializes, so
+  long-context training stays VMEM/HBM bounded by single tiles.
 
 Interpret mode (CPU tests) is selected automatically off the backend.
 """
@@ -156,14 +157,167 @@ def _flash_fwd_impl(q, k, v, q_offset, kv_offset, *, causal, scale,
     return out, lse
 
 
-def _reference(q, k, v, q_offset, kv_offset, causal, scale):
-    """Pure-jnp twin used for the backward pass (recomputation) — the
-    shared offset-aware dense attention, so mask/numeric semantics cannot
-    drift between the Pallas forward and the recomputed backward."""
-    from bluefog_tpu.parallel.ring_attention import full_attention
+def _recompute_p(q, k, lse, q_off, kv_off, qi, kj, block_q, block_k, scale,
+                 causal):
+    """Recompute the normalized probability block P = exp(S - lse) with the
+    global causal mask; fully-masked entries (S == _NEG_INF) go to 0 even
+    when the whole row is masked (lse == _NEG_INF would give exp(0))."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = (q_off + qi * block_q +
+                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+        kv_pos = (kv_off + kj * block_k +
+                  jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+        s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    return jnp.where(s <= _NEG_INF / 2, 0.0, p)
 
-    return full_attention(q, k, v, causal=causal, scale=scale,
-                          q_offset=q_offset, kv_offset=kv_offset)
+
+def _bwd_dq_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, acc_ref, *, causal, scale):
+    """Grid (bh, qi, kj): accumulate dQ_i = sum_j dS_ij K_j * scale."""
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    block_q, d = q.shape
+    block_k = k.shape[0]
+
+    @pl.when(kj == 0)
+    def _():
+        acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
+
+    p = _recompute_p(q, k, lse, q_off_ref[0], kv_off_ref[0], qi, kj,
+                     block_q, block_k, scale, causal)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None])
+    acc_ref[:] += jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kj == n_k - 1)
+    def _():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    causal, scale, group):
+    """Grid (b*h_kv, kj, qi*group): accumulate dK_j / dV_j over every query
+    block and every query head in this KV head's group."""
+    t = pl.program_id(2)
+    n_t = pl.num_programs(2)
+    qi = t // group
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    block_q, d = q.shape
+    block_k = k.shape[0]
+
+    @pl.when(t == 0)
+    def _():
+        dk_acc[:] = jnp.zeros((block_k, d), jnp.float32)
+        dv_acc[:] = jnp.zeros((block_k, d), jnp.float32)
+
+    kj = pl.program_id(1)
+    p = _recompute_p(q, k, lse, q_off_ref[0], kv_off_ref[0], qi, kj,
+                     block_q, block_k, scale, causal)
+    dv_acc[:] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None])
+    dk_acc[:] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+
+    @pl.when(t == n_t - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, out, lse, do, q_offset, kv_offset, *, causal,
+                    scale, block_q, block_k, interpret):
+    b, t_q, h, d = q.shape
+    h_kv, t_k = k.shape[2], k.shape[1]
+    group = h // h_kv
+    block_q = _fit_block(t_q, block_q)
+    block_k = _fit_block(t_k, block_k)
+
+    qt = jnp.moveaxis(q, 2, 1).reshape(b * h, t_q, d)
+    kt = jnp.moveaxis(k, 2, 1).reshape(b * h_kv, t_k, d)
+    vt = jnp.moveaxis(v, 2, 1).reshape(b * h_kv, t_k, d)
+    dot = jnp.moveaxis(do, 2, 1).reshape(b * h, t_q, d)
+    lse3 = lse.reshape(b * h, t_q, 1)
+    # delta = rowsum(dO * O), the softmax-jacobian diagonal term
+    delta3 = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                     axis=-1)  # [B, T, H]
+    delta3 = jnp.moveaxis(delta3, 2, 1).reshape(b * h, t_q, 1)
+    q_off = jnp.reshape(jnp.asarray(q_offset, jnp.int32), (1,))
+    kv_off = jnp.reshape(jnp.asarray(kv_offset, jnp.int32), (1,))
+
+    def kv_index(bh, qi, kj):
+        return (bh // h * h_kv + (bh % h) // group, kj, 0)
+
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale),
+        grid=(b * h, t_q // block_q, t_k // block_k),
+        in_specs=[smem, smem, q_spec,
+                  pl.BlockSpec((1, block_k, d), kv_index),
+                  pl.BlockSpec((1, block_k, d), kv_index),
+                  q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q_off, kv_off, qt, kt, vt, dot, lse3, delta3)
+
+    # dK/dV: grid row is a KV head; the innermost dim sweeps (q block,
+    # group member) pairs so GQA head sums accumulate in scratch instead of
+    # materializing widened dK/dV.
+    def q_row(bkv, kj, t):
+        return ((bkv // h_kv) * h + (bkv % h_kv) * group + t % group,
+                t // group, 0)
+
+    kv_self = pl.BlockSpec((1, block_k, d), lambda bkv, kj, t: (bkv, kj, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          group=group),
+        grid=(b * h_kv, t_k // block_k, (t_q // block_q) * group),
+        in_specs=[smem, smem,
+                  pl.BlockSpec((1, block_q, d), q_row),
+                  kv_self, kv_self,
+                  pl.BlockSpec((1, block_q, d), q_row),
+                  pl.BlockSpec((1, block_q, 1), q_row),
+                  pl.BlockSpec((1, block_q, 1), q_row)],
+        out_specs=[kv_self, kv_self],
+        out_shape=[jax.ShapeDtypeStruct((b * h_kv, t_k, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h_kv, t_k, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q_off, kv_off, qt, kt, vt, dot, lse3, delta3)
+
+    dq = jnp.moveaxis(dq.reshape(b, h, t_q, d), 1, 2)
+    dk = jnp.moveaxis(dk.reshape(b, h_kv, t_k, d), 1, 2)
+    dv = jnp.moveaxis(dv.reshape(b, h_kv, t_k, d), 1, 2)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
@@ -177,18 +331,17 @@ def _flash(q, k, v, q_offset, kv_offset, causal, scale, block_q, block_k,
 
 def _flash_fwd(q, k, v, q_offset, kv_offset, causal, scale, block_q, block_k,
                interpret):
-    out, _ = _flash_fwd_impl(q, k, v, q_offset, kv_offset, causal=causal,
-                             scale=scale, block_q=block_q, block_k=block_k,
-                             interpret=interpret)
-    return out, (q, k, v, q_offset, kv_offset)
+    out, lse = _flash_fwd_impl(q, k, v, q_offset, kv_offset, causal=causal,
+                               scale=scale, block_q=block_q, block_k=block_k,
+                               interpret=interpret)
+    return out, (q, k, v, out, lse, q_offset, kv_offset)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, q_offset, kv_offset = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _reference(q, k, v, q_offset, kv_offset, causal,
-                                   scale), q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, out, lse, q_offset, kv_offset = res
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, out, lse, g, q_offset, kv_offset, causal=causal,
+        scale=scale, block_q=block_q, block_k=block_k, interpret=interpret)
     return dq, dk, dv, None, None
 
 

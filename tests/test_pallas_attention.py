@@ -60,15 +60,39 @@ def test_flash_lse_values():
                                atol=1e-5)
 
 
-def test_flash_gradients():
-    q, k, v = _qkv(jax.random.PRNGKey(3), 1, 32, 32, 2, 2, 8)
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("hkv", [2, 1])
+def test_flash_gradients(causal, hkv):
+    """Kernel backward (two blockwise passes) vs dense reference grads,
+    including GQA head-group accumulation."""
+    q, k, v = _qkv(jax.random.PRNGKey(3), 2, 32, 32, 2, hkv, 8)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True,
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
                                        block_q=8, block_k=8) ** 2)
 
     def loss_ref(q, k, v):
-        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+        return jnp.sum(full_attention(q, k, v, causal=causal) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_gradients_with_offsets():
+    """Backward respects the global-coordinate causal mask."""
+    q, k, v = _qkv(jax.random.PRNGKey(6), 1, 16, 16, 2, 2, 8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, q_offset=64,
+                                       kv_offset=0, block_q=8,
+                                       block_k=8) ** 2)
+
+    def loss_ref(q, k, v):
+        # a fully-past kv block == non-causal attention
+        return jnp.sum(full_attention(q, k, v, causal=False) ** 2)
 
     g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
