@@ -108,3 +108,9 @@ from bluefog_tpu.utility import (  # noqa: F401
 
 from bluefog_tpu import topology  # noqa: F401
 from bluefog_tpu import optim  # noqa: F401
+from bluefog_tpu import data  # noqa: F401
+from bluefog_tpu.data import (  # noqa: F401
+    DataLoader,
+    DistributedSampler,
+    device_prefetch,
+)

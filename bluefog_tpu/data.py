@@ -1,0 +1,297 @@
+"""Input pipeline: sharded, shuffled, prefetching data loading.
+
+The reference has no input pipeline of its own — every example iterates a
+``torch.utils.data.DataLoader`` with a ``DistributedSampler`` partitioning
+the dataset by rank (reference examples/pytorch_mnist.py,
+pytorch_resnet.py).  A standalone framework needs its own; this one is
+TPU-shaped:
+
+* **Sampling lives in Python** (``DistributedSampler``): per-epoch global
+  permutation -> per-rank disjoint shards, torch-DistributedSampler
+  semantics (pad-by-wrapping unless ``drop_last``).  Keeping index math in
+  one place makes the native and pure-Python paths bit-identical.
+* **Gathering lives in C++** (``native.NativeBatchPipeline``): worker
+  threads copy scattered records into a ring of pre-allocated contiguous
+  batch buffers, overlapping host-side batch assembly with device compute.
+  Falls back to a Python thread when the native library is unavailable.
+* **Rank-major delivery**: under single-process SPMD (the normal BlueFog-
+  TPU shape) ``DataLoader(..., rank_major=True)`` yields global
+  ``[world, per_rank_batch, ...]`` arrays ready for ``device_put`` with a
+  rank-major sharding — each rank's row is its own disjoint shard stream.
+* ``device_prefetch`` overlaps host->device transfer one batch ahead.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["DistributedSampler", "DataLoader", "device_prefetch"]
+
+
+class DistributedSampler:
+    """Per-epoch index streams: one global permutation, sharded by rank.
+
+    Semantics follow torch's DistributedSampler (the sampler the reference's
+    examples use): when ``drop_last`` is False the index list is padded by
+    wrapping so every rank gets the same count; when True the tail that
+    doesn't divide evenly is dropped.  ``set_epoch`` (or the ``epoch``
+    argument) reshuffles deterministically from ``seed``.
+    """
+
+    def __init__(self, n_items: int, rank: int = 0, world: int = 1,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = False):
+        if not 0 <= rank < world:
+            raise ValueError(f"rank {rank} outside world {world}")
+        self.n_items = int(n_items)
+        self.rank = rank
+        self.world = world
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        if drop_last:
+            self.num_samples = self.n_items // world
+        else:
+            self.num_samples = -(-self.n_items // world)  # ceil
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def indices(self, epoch: Optional[int] = None) -> np.ndarray:
+        """This rank's sample indices for ``epoch`` (local view of the
+        shared global permutation)."""
+        if epoch is None:
+            epoch = self.epoch
+        if self.shuffle:
+            rng = np.random.Generator(
+                np.random.Philox(key=self.seed + epoch))
+            order = rng.permutation(self.n_items)
+        else:
+            order = np.arange(self.n_items)
+        total = self.num_samples * self.world
+        if total > len(order):  # pad by wrapping/tiling (not drop_last)
+            reps = -(-total // len(order))
+            order = np.tile(order, reps)
+        order = order[:total]
+        # interleaved assignment (rank r takes order[r::world]), matching
+        # torch's DistributedSampler
+        return np.ascontiguousarray(order[self.rank::self.world])
+
+    def __iter__(self):
+        return iter(self.indices())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class _PythonPipeline:
+    """Fallback gather engine: one producer thread, same batch semantics
+    and bit-identical output to the native pipeline."""
+
+    def __init__(self, fields: List[np.ndarray], batch_size: int,
+                 depth: int = 3, workers: int = 1):
+        del workers
+        self._fields = fields
+        self._batch = batch_size
+        self._depth = depth
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._thread: Optional[threading.Thread] = None
+        self._cancel = threading.Event()
+
+    def start_epoch(self, order) -> int:
+        self._drain()
+        order = np.ascontiguousarray(order, dtype=np.int64)
+        n_batches = -(-len(order) // self._batch)
+        self._cancel = threading.Event()
+        cancel = self._cancel
+
+        def produce():
+            for b in range(n_batches):
+                if cancel.is_set():
+                    return
+                idx = order[b * self._batch:(b + 1) * self._batch]
+                self._q.put([np.ascontiguousarray(f[idx])
+                             for f in self._fields])
+            self._q.put(None)
+
+        self._thread = threading.Thread(target=produce, daemon=True)
+        self._thread.start()
+        return n_batches
+
+    def next(self):
+        views = self._q.get()
+        if views is None:
+            return None
+        return 0, views
+
+    def release(self, slot: int):
+        del slot
+
+    def _drain(self):
+        if self._thread is not None and self._thread.is_alive():
+            self._cancel.set()
+            while True:  # unblock a producer stuck on a full queue
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+            self._thread.join(timeout=10)
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+    def close(self):
+        self._drain()
+
+
+class DataLoader:
+    """Sharded, shuffled, prefetching batch iterator over array fields.
+
+    ``fields`` is a tuple/list of numpy arrays with a shared leading sample
+    dimension (e.g. ``(images, labels)``).  Each epoch yields tuples of
+    numpy batches; re-iterating reshuffles (sampler epoch auto-increments).
+
+    With ``rank_major=True`` and ``world=n`` (default: the bluefog world
+    size if initialized), every yield is the GLOBAL batch
+    ``[n, per_rank_batch, ...]`` — row r is rank r's disjoint shard, the
+    layout every ``bluefog_tpu`` op and train step expects.  In
+    multi-process pods pass ``rank_major=False`` and ``rank=process rank``
+    to stream only the local shard.
+
+    Yielded arrays are copies owned by the caller (slot buffers are
+    recycled as soon as the next batch is requested).
+    """
+
+    def __init__(self, fields: Sequence[np.ndarray], batch_size: int,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = False, rank: int = 0,
+                 world: Optional[int] = None, rank_major: bool = False,
+                 num_workers: int = 2, prefetch_depth: int = 3,
+                 transform=None, use_native: Optional[bool] = None):
+        from bluefog_tpu import native
+
+        self._fields = [np.ascontiguousarray(f) for f in fields]
+        n = self._fields[0].shape[0]
+        if world is None:
+            from bluefog_tpu import api
+
+            world = api.size() if api.is_initialized() else 1
+        self.rank_major = rank_major
+        self.world = world
+        if rank_major:
+            # one interleaved global stream: sampler shards inside batches
+            self._sampler = DistributedSampler(
+                n, rank=0, world=1, shuffle=shuffle, seed=seed,
+                drop_last=drop_last)
+            if batch_size % world:
+                raise ValueError(
+                    f"rank_major needs batch_size % world == 0, got "
+                    f"{batch_size} % {world}")
+        else:
+            self._sampler = DistributedSampler(
+                n, rank=rank, world=world, shuffle=shuffle, seed=seed,
+                drop_last=drop_last)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._transform = transform
+        if use_native is None:
+            use_native = native.available()
+        if use_native:
+            self._pipe = native.NativeBatchPipeline(
+                self._fields, batch_size, depth=prefetch_depth,
+                workers=num_workers)
+        else:
+            self._pipe = _PythonPipeline(
+                self._fields, batch_size, depth=prefetch_depth)
+        self.native = use_native
+        self._epoch_next = 0
+
+    @property
+    def sampler(self) -> DistributedSampler:
+        return self._sampler
+
+    def __len__(self):
+        per_epoch = len(self._sampler)
+        if self.drop_last:
+            return per_epoch // self.batch_size
+        return -(-per_epoch // self.batch_size)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        epoch = self._epoch_next
+        self._epoch_next += 1
+        order = self._sampler.indices(epoch)
+        if self.drop_last:
+            order = order[:len(order) - len(order) % self.batch_size]
+        elif self.rank_major and len(order) % self.world:
+            # pad by wrapping so the trailing partial batch still splits
+            # into equal per-rank rows (batch_size % world == 0 and
+            # len(order) % world == 0 imply count % world == 0) — same
+            # pad-for-equal-shards rule as DistributedSampler
+            pad = self.world - len(order) % self.world
+            order = np.resize(order, len(order) + pad)  # tiles if pad > len
+        self._pipe.start_epoch(order)
+        while True:
+            item = self._pipe.next()
+            if item is None:
+                break
+            slot, views = item
+            batch = tuple(v.copy() for v in views)
+            self._pipe.release(slot)
+            if self.rank_major:
+                per = batch[0].shape[0] // self.world
+                batch = tuple(
+                    b.reshape((self.world, per) + b.shape[1:])
+                    for b in batch)
+            if self._transform is not None:
+                batch = self._transform(*batch)
+            yield batch
+
+    def close(self):
+        self._pipe.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def device_prefetch(iterator, sharding=None, depth: int = 2):
+    """Move batches to device ``depth`` steps ahead of the consumer.
+
+    Wraps any host-batch iterator; each element (tuple of arrays) is
+    ``jax.device_put`` (with ``sharding`` if given) while the previous
+    batch is still being consumed, overlapping H2D transfer with compute.
+    """
+    import collections
+
+    import jax
+
+    buf = collections.deque()
+
+    def put(batch):
+        if sharding is None:
+            return jax.device_put(batch)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), batch)
+
+    it = iter(iterator)
+    try:
+        for _ in range(depth):
+            buf.append(put(next(it)))
+    except StopIteration:
+        pass
+    while buf:
+        out = buf.popleft()
+        try:
+            buf.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out

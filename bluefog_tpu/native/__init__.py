@@ -2,9 +2,13 @@
 
 The reference's native layer bridges framework tensors to MPI/NCCL; on TPU
 XLA supplies the data plane, so the native components here are the runtime
-pieces AROUND the compute path (SURVEY.md §7.9): currently the Chrome-
-tracing timeline writer (lock-free SPSC ring + writer thread, mirroring
-reference common/timeline.{h,cc}).
+pieces AROUND the compute path (SURVEY.md §7.9):
+
+* ``bf_native.cc`` — Chrome-tracing timeline writer (lock-free SPSC ring +
+  writer thread, mirroring reference common/timeline.{h,cc});
+* ``bf_data.cc`` — batch-gather data engine (worker pool filling a ring of
+  pre-allocated host batch buffers; the input pipeline the reference gets
+  from torch's C++ DataLoader).
 
 The shared library is built lazily with g++ on first use and cached next to
 the source; every consumer must degrade gracefully when ``available()`` is
@@ -20,7 +24,8 @@ import threading
 from typing import Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "bf_native.cc")
+_SRCS = [os.path.join(_HERE, "bf_native.cc"),
+         os.path.join(_HERE, "bf_data.cc")]
 _LIB = os.path.join(_HERE, "libbf_native.so")
 
 _lock = threading.Lock()
@@ -33,7 +38,7 @@ def _build() -> bool:
     # must not clobber each other's output mid-write
     tmp = f"{_LIB}.tmp.{os.getpid()}"
     cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", tmp,
-           _SRC, "-lpthread"]
+           *_SRCS, "-lpthread"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120,
                        text=True)
@@ -69,7 +74,8 @@ def _load() -> Optional[ctypes.CDLL]:
         if _build_failed:
             return None
         stale = (not os.path.exists(_LIB) or
-                 os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+                 os.path.getmtime(_LIB) < max(os.path.getmtime(s)
+                                              for s in _SRCS))
         if stale and not _build():
             _build_failed = True
             return None
@@ -87,6 +93,27 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.bf_timeline_dropped.argtypes = [ctypes.c_void_p]
         lib.bf_timeline_close.restype = None
         lib.bf_timeline_close.argtypes = [ctypes.c_void_p]
+        lib.bfdata_create.restype = ctypes.c_void_p
+        lib.bfdata_create.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int]
+        lib.bfdata_start_epoch.restype = None
+        lib.bfdata_start_epoch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        lib.bfdata_num_batches.restype = ctypes.c_longlong
+        lib.bfdata_num_batches.argtypes = [ctypes.c_void_p]
+        lib.bfdata_next.restype = ctypes.c_longlong
+        lib.bfdata_next.argtypes = [ctypes.c_void_p]
+        lib.bfdata_release.restype = None
+        lib.bfdata_release.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        lib.bfdata_slot_ptr.restype = ctypes.c_void_p
+        lib.bfdata_slot_ptr.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int]
+        lib.bfdata_slot_count.restype = ctypes.c_longlong
+        lib.bfdata_slot_count.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        lib.bfdata_destroy.restype = None
+        lib.bfdata_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -124,3 +151,82 @@ class NativeTimelineWriter:
                 self._lib.bf_timeline_dropped(self._handle))
             self._lib.bf_timeline_close(self._handle)
             self._handle = None
+
+
+class NativeBatchPipeline:
+    """ctypes facade over the C++ DataPipeline (bf_data.cc): multi-threaded
+    gather of scattered records into a depth-deep ring of contiguous batch
+    buffers, delivered strictly in order.
+
+    ``fields`` are C-contiguous numpy arrays sharing a leading sample dim;
+    the caller must keep them alive for the pipeline's lifetime (this class
+    holds references).  Buffers returned by ``next()`` are views into ring
+    slots — valid only until ``release(slot)``.
+    """
+
+    def __init__(self, fields, batch_size: int, depth: int = 3,
+                 workers: int = 2):
+        import numpy as np
+
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._fields = [np.ascontiguousarray(f) for f in fields]
+        n = self._fields[0].shape[0]
+        for f in self._fields:
+            if f.shape[0] != n:
+                raise ValueError("all fields need the same sample count")
+        self._batch = int(batch_size)
+        self._item_shapes = [f.shape[1:] for f in self._fields]
+        self._dtypes = [f.dtype for f in self._fields]
+        item_bytes = [int(f.nbytes // max(n, 1)) for f in self._fields]
+        ptrs = (ctypes.c_void_p * len(fields))(
+            *[f.ctypes.data_as(ctypes.c_void_p).value for f in self._fields])
+        bts = (ctypes.c_int64 * len(fields))(*item_bytes)
+        self._handle = lib.bfdata_create(
+            len(fields), ptrs, bts, n, self._batch, depth, workers)
+        if not self._handle:
+            raise RuntimeError("bfdata_create failed")
+
+    def start_epoch(self, order) -> int:
+        """Install this epoch's sample-index order; returns batch count."""
+        import numpy as np
+
+        order = np.ascontiguousarray(order, dtype=np.int64)
+        self._lib.bfdata_start_epoch(
+            self._handle, order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(order))
+        return int(self._lib.bfdata_num_batches(self._handle))
+
+    def next(self):
+        """Blocking: (slot, [field views]) or None at epoch end."""
+        import numpy as np
+
+        slot = int(self._lib.bfdata_next(self._handle))
+        if slot < 0:
+            return None
+        count = int(self._lib.bfdata_slot_count(self._handle, slot))
+        views = []
+        for f, (shape, dtype) in enumerate(
+                zip(self._item_shapes, self._dtypes)):
+            ptr = self._lib.bfdata_slot_ptr(self._handle, slot, f)
+            nbytes = count * int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            raw = (ctypes.c_uint8 * nbytes).from_address(ptr)
+            views.append(np.frombuffer(raw, dtype=dtype).reshape(
+                (count,) + tuple(shape)))
+        return slot, views
+
+    def release(self, slot: int):
+        self._lib.bfdata_release(self._handle, slot)
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.bfdata_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -38,6 +38,29 @@ __all__ = ["flash_attention", "flash_attention_with_lse"]
 _NEG_INF = -1e30
 
 
+def _apply_causal_mask(s, q_off, kv_off, qi, kj):
+    """Mask scores s [block_q, block_k] with the GLOBAL causal rule
+    q_pos >= kv_pos, where positions include the ring-step offsets held in
+    SMEM.  Single source of truth for forward, dQ and dK/dV kernels."""
+    block_q, block_k = s.shape
+    q_pos = (q_off + qi * block_q +
+             jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+    kv_pos = (kv_off + kj * block_k +
+              jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+    return jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+
+
+def _kv_index_map(h: int, h_kv: int):
+    """BlockSpec index map folding query-head grid rows onto KV heads:
+    row bh = batch*H + head  ->  kv row batch*H_kv + head // (H/H_kv)."""
+    group = h // h_kv
+
+    def kv_index(bh, qi, kj):
+        return (bh // h * h_kv + (bh % h) // group, kj, 0)
+
+    return kv_index
+
+
 def _kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             m_ref, l_ref, acc_ref, *, causal: bool, scale: float):
     """Grid = (batch*heads, q blocks, k blocks).  Only one (block_q, D) Q
@@ -64,11 +87,7 @@ def _kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         q, k_blk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # [bq, bk]
     if causal:
-        q_pos = (q_off_ref[0] + qi * block_q +
-                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
-        kv_pos = (kv_off_ref[0] + kj * block_k +
-                  jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
-        s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        s = _apply_causal_mask(s, q_off_ref[0], kv_off_ref[0], qi, kj)
     m, l, acc = m_ref[:], l_ref[:], acc_ref[:]
     blk_m = jnp.max(s, axis=-1)
     new_m = jnp.maximum(m, blk_m)
@@ -109,7 +128,6 @@ def _flash_fwd_impl(q, k, v, q_offset, kv_offset, *, causal, scale,
     b, t_q, h, d = q.shape
     h_kv = k.shape[2]
     t_k = k.shape[1]
-    group = h // h_kv
     block_q = _fit_block(t_q, block_q)
     block_k = _fit_block(t_k, block_k)
 
@@ -120,10 +138,7 @@ def _flash_fwd_impl(q, k, v, q_offset, kv_offset, *, causal, scale,
     q_off = jnp.reshape(jnp.asarray(q_offset, jnp.int32), (1,))
     kv_off = jnp.reshape(jnp.asarray(kv_offset, jnp.int32), (1,))
 
-    def kv_index(bh, qi, kj):
-        # query row bh = batch*H + head  ->  kv row batch*H_kv + head//group
-        return (bh // h * h_kv + (bh % h) // group, kj, 0)
-
+    kv_index = _kv_index_map(h, h_kv)
     grid = (b * h, t_q // block_q, t_k // block_k)
     out, lse = pl.pallas_call(
         functools.partial(_kernel, causal=causal, scale=scale),
@@ -157,8 +172,7 @@ def _flash_fwd_impl(q, k, v, q_offset, kv_offset, *, causal, scale,
     return out, lse
 
 
-def _recompute_p(q, k, lse, q_off, kv_off, qi, kj, block_q, block_k, scale,
-                 causal):
+def _recompute_p(q, k, lse, q_off, kv_off, qi, kj, scale, causal):
     """Recompute the normalized probability block P = exp(S - lse) with the
     global causal mask; fully-masked entries (S == _NEG_INF) go to 0 even
     when the whole row is masked (lse == _NEG_INF would give exp(0))."""
@@ -166,11 +180,7 @@ def _recompute_p(q, k, lse, q_off, kv_off, qi, kj, block_q, block_k, scale,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     if causal:
-        q_pos = (q_off + qi * block_q +
-                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
-        kv_pos = (kv_off + kj * block_k +
-                  jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
-        s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        s = _apply_causal_mask(s, q_off, kv_off, qi, kj)
     p = jnp.exp(s - lse[:, None])
     return jnp.where(s <= _NEG_INF / 2, 0.0, p)
 
@@ -195,7 +205,7 @@ def _bwd_dq_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
         acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
 
     p = _recompute_p(q, k, lse, q_off_ref[0], kv_off_ref[0], qi, kj,
-                     block_q, block_k, scale, causal)
+                     scale, causal)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - delta[:, None])
@@ -232,7 +242,7 @@ def _bwd_dkv_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
 
     kj = pl.program_id(1)
     p = _recompute_p(q, k, lse, q_off_ref[0], kv_off_ref[0], qi, kj,
-                     block_q, block_k, scale, causal)
+                     scale, causal)
     dv_acc[:] += jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -268,9 +278,7 @@ def _flash_bwd_impl(q, k, v, out, lse, do, q_offset, kv_offset, *, causal,
     q_off = jnp.reshape(jnp.asarray(q_offset, jnp.int32), (1,))
     kv_off = jnp.reshape(jnp.asarray(kv_offset, jnp.int32), (1,))
 
-    def kv_index(bh, qi, kj):
-        return (bh // h * h_kv + (bh % h) // group, kj, 0)
-
+    kv_index = _kv_index_map(h, h_kv)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0))
     row_spec = pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0))

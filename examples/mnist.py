@@ -5,6 +5,11 @@ a selectable distributed optimizer.  Uses a deterministic synthetic
 MNIST-shaped dataset (zero-egress environment: each class is a noisy
 template), which is enough to demonstrate every optimizer converging.
 
+Data flows through the framework's own input pipeline (the reference uses
+torch DataLoader + DistributedSampler, pytorch_mnist.py:160-170):
+``bf.DataLoader(rank_major=True)`` shards a shuffled global stream into
+disjoint per-rank rows, gathered by the native C++ prefetch engine.
+
   --dist-optimizer: neighbor_allreduce (CTA) | allreduce | gradient_allreduce
                     | hierarchical_neighbor_allreduce | win_put | pull_get
                     | push_sum | horovod (alias of gradient_allreduce)
@@ -41,18 +46,14 @@ parser.add_argument("--samples-per-rank", type=int, default=256)
 args = parser.parse_args()
 
 
-def synthetic_mnist(n_ranks, samples, seed=0):
-    """Class templates + noise; shape [n, samples, 28, 28, 1], labels [n, s]."""
+def synthetic_mnist(samples, seed=0):
+    """Class templates + noise; one global pool [samples, 28, 28, 1]."""
     rng = np.random.RandomState(seed)
     templates = rng.rand(10, 28, 28, 1) > 0.7
-    xs, ys = [], []
-    for r in range(n_ranks):
-        labels = rng.randint(0, 10, samples)
-        imgs = templates[labels].astype(np.float32)
-        imgs += 0.3 * rng.randn(samples, 28, 28, 1)
-        xs.append(imgs)
-        ys.append(labels)
-    return np.stack(xs).astype(np.float32), np.stack(ys).astype(np.int32)
+    labels = rng.randint(0, 10, samples)
+    imgs = templates[labels].astype(np.float32)
+    imgs += 0.3 * rng.randn(samples, 28, 28, 1)
+    return imgs.astype(np.float32), labels.astype(np.int32)
 
 
 def make_optimizer(base):
@@ -82,7 +83,10 @@ def main():
         bf.set_machine_topology(ExponentialGraph(bf.machine_size()))
     n = bf.size()
     model = models.MnistNet()
-    xs, ys = synthetic_mnist(n, args.samples_per_rank)
+    images, labels = synthetic_mnist(n * args.samples_per_rank)
+    loader = bf.DataLoader([images, labels],
+                           batch_size=n * args.batch_size, world=n,
+                           rank_major=True, drop_last=True, seed=1)
 
     sample = jnp.zeros((1, 28, 28, 1))
     base_params = model.init(jax.random.PRNGKey(42), sample)
@@ -102,24 +106,25 @@ def main():
     opt = make_optimizer(optax.sgd(args.lr, momentum=0.9))
     state = opt.init(params)
 
-    steps_per_epoch = args.samples_per_rank // args.batch_size
     first_loss = None
+    steps = 0
     for epoch in range(args.epochs):
         correct = total = 0
-        for s in range(steps_per_epoch):
-            lo, hi = s * args.batch_size, (s + 1) * args.batch_size
-            x = bf.rank_sharded(xs[:, lo:hi])
-            y = bf.rank_sharded(ys[:, lo:hi])
+        for bx, by in loader:
+            x = bf.rank_sharded(bx)
+            y = bf.rank_sharded(by)
             (loss, logits), grads = grad_fn(params, x, y)
             params, state = opt.step(params, grads, state)
+            steps += 1
             if first_loss is None:
                 first_loss = float(loss)
             pred = np.asarray(jnp.argmax(logits, -1))
-            correct += (pred == ys[:, lo:hi]).sum()
+            correct += (pred == by).sum()
             total += pred.size
         print(f"epoch {epoch}: loss={float(loss):.4f} "
               f"train_acc={correct / total:.3f}")
-    if args.epochs * steps_per_epoch > 1:
+    loader.close()
+    if steps > 1:
         assert float(loss) < first_loss, (
             f"training made no progress: {first_loss} -> {float(loss)}")
     bf.shutdown()
