@@ -186,6 +186,11 @@ class DataLoader:
         self.rank_major = rank_major
         self.world = world
         if rank_major:
+            if rank != 0:
+                raise ValueError(
+                    "rank_major streams the GLOBAL batch (one process feeds "
+                    "all ranks); rank must stay 0 — in a multi-process pod "
+                    "use rank_major=False with rank=process rank")
             # one interleaved global stream: sampler shards inside batches
             self._sampler = DistributedSampler(
                 n, rank=0, world=1, shuffle=shuffle, seed=seed,

@@ -17,8 +17,13 @@ from bluefog_tpu.models.resnet import (
     ResNet152,
 )
 from bluefog_tpu.models.llama import Llama, LlamaConfig
+from bluefog_tpu.models.vit import ViT, ViTConfig, ViT_B16, ViT_S16
 
 __all__ = [
+    "ViT",
+    "ViTConfig",
+    "ViT_S16",
+    "ViT_B16",
     "MLP",
     "MnistNet",
     "ResNet",

@@ -1,0 +1,150 @@
+"""Vision Transformer, TPU-first.
+
+Rounds out the vision side of the model zoo next to ResNet (the reference
+benchmarks torchvision models only — reference examples/pytorch_resnet.py:54;
+ViT is the modern equivalent workload).  Fresh flax.linen implementation:
+bf16 compute over f32 params, NHWC patchify via a strided conv (one MXU-
+friendly matmul per image), learned position embeddings, pre-LN blocks.
+
+``attn_impl='flash'`` routes token attention through the Pallas flash
+kernel (non-causal); ``attn_mode='blockwise'`` gives the VMEM-bounded XLA
+path for very long token sequences.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from bluefog_tpu.parallel.ring_attention import (
+    blockwise_attention,
+    full_attention,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    dim: int = 768
+    depth: int = 12
+    n_heads: int = 12
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_mode: str = "full"  # full | blockwise
+    attn_impl: str = "xla"  # xla | flash (Pallas)
+    attn_block_size: int = 256
+    pool: str = "cls"  # cls | gap
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def small(**overrides) -> "ViTConfig":
+        return ViTConfig(dim=384, depth=12, n_heads=6, **overrides)
+
+    @staticmethod
+    def base(**overrides) -> "ViTConfig":
+        return ViTConfig(dim=768, depth=12, n_heads=12, **overrides)
+
+    @staticmethod
+    def tiny(**overrides) -> "ViTConfig":
+        """Test-scale config."""
+        return ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                         dim=64, depth=2, n_heads=4, **overrides)
+
+
+class _Attention(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        hd = cfg.head_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        q = dense(cfg.dim, "wq")(x).reshape(b, t, cfg.n_heads, hd)
+        k = dense(cfg.dim, "wk")(x).reshape(b, t, cfg.n_heads, hd)
+        v = dense(cfg.dim, "wv")(x).reshape(b, t, cfg.n_heads, hd)
+        if cfg.attn_impl == "flash":
+            from bluefog_tpu.parallel.pallas_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=False,
+                                  block_q=min(cfg.attn_block_size, t),
+                                  block_k=min(cfg.attn_block_size, t))
+        elif cfg.attn_mode == "blockwise":
+            out = blockwise_attention(q, k, v, cfg.attn_block_size,
+                                      causal=False)
+        else:
+            out = full_attention(q, k, v, causal=False)
+        return dense(cfg.dim, "wo")(out.reshape(b, t, cfg.dim))
+
+
+class _Block(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(dtype=cfg.dtype,
+                                       param_dtype=jnp.float32, name=name)
+        x = x + _Attention(cfg, name="attn")(ln("norm1")(x))
+        h = ln("norm2")(x)
+        h = nn.Dense(cfg.dim * cfg.mlp_ratio, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.dim, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlp_out")(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    """images [B, H, W, 3] -> logits [B, num_classes] (f32)."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.cfg
+        b = images.shape[0]
+        x = nn.Conv(cfg.dim, (cfg.patch_size, cfg.patch_size),
+                    strides=(cfg.patch_size, cfg.patch_size),
+                    dtype=cfg.dtype, param_dtype=jnp.float32,
+                    name="patch_embed")(images.astype(cfg.dtype))
+        x = x.reshape(b, -1, cfg.dim)  # [B, T, dim]
+        t = x.shape[1]
+        if cfg.pool == "cls":
+            cls = self.param("cls_token", nn.initializers.zeros,
+                             (1, 1, cfg.dim), jnp.float32)
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (b, 1, cfg.dim)).astype(cfg.dtype), x],
+                axis=1)
+            t += 1
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, t, cfg.dim), jnp.float32)
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.depth):
+            x = _Block(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="norm")(x)
+        x = x[:, 0] if cfg.pool == "cls" else jnp.mean(x, axis=1)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(x)
+
+
+def ViT_S16(**overrides) -> ViT:
+    return ViT(ViTConfig.small(**overrides))
+
+
+def ViT_B16(**overrides) -> ViT:
+    return ViT(ViTConfig.base(**overrides))

@@ -27,6 +27,7 @@ Design notes
 
 from __future__ import annotations
 
+import os
 import string
 from functools import partial
 from typing import Optional, Sequence, Union
@@ -39,6 +40,11 @@ from jax import lax
 from bluefog_tpu.topology.spec import DynamicTopology, Topology
 
 CommSpec = Union[Topology, DynamicTopology]
+
+# Read once at import: ops are trace-cached by name/shape, so flipping the
+# env var mid-run could never reliably switch an already-compiled combine —
+# requiring it at import makes the contract honest.
+_FUSED_COMBINE = os.environ.get("BLUEFOG_FUSED_COMBINE", "")
 
 __all__ = [
     "allreduce",
@@ -112,11 +118,23 @@ def neighbor_allreduce(
     acc_dtype = _accum_dtype(x.dtype)
     idx = lax.axis_index(axis_name)
     self_w = jnp.asarray(_self_weights_of(spec), dtype=acc_dtype)[idx]
-    acc = x.astype(acc_dtype) * self_w
+    received, weights = [], [self_w]
     for cls in spec.shift_classes:
-        received = lax.ppermute(x, axis_name, cls.perm)
-        w = jnp.asarray(cls.recv_weights, dtype=acc_dtype)[idx]
-        acc = acc + received.astype(acc_dtype) * w
+        received.append(lax.ppermute(x, axis_name, cls.perm))
+        weights.append(jnp.asarray(cls.recv_weights, dtype=acc_dtype)[idx])
+    if (received and _FUSED_COMBINE == "pallas"
+            and acc_dtype != jnp.dtype(jnp.float64)):
+        # hand-tuned single-pass kernel (SURVEY §7.9a); measured at parity
+        # with the XLA-fused default — see parallel/fused_combine.py.
+        # f64 stays on the XLA path: Pallas TPU has no f64 and the kernel
+        # accumulates in f32, which would silently drop precision.
+        from bluefog_tpu.parallel.fused_combine import fused_weighted_combine
+
+        return fused_weighted_combine(
+            x, received, jnp.stack([w.astype(jnp.float32) for w in weights]))
+    acc = x.astype(acc_dtype) * self_w
+    for r, w in zip(received, weights[1:]):
+        acc = acc + r.astype(acc_dtype) * w
     return acc.astype(x.dtype)
 
 

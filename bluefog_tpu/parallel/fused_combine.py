@@ -1,0 +1,153 @@
+"""Fused weighted neighbor-combine Pallas kernel.
+
+SURVEY.md §7.9(a): the reference's only CUDA kernel scales a buffer by a
+destination weight before sending (reference
+bluefog/common/cuda/cuda_kernels.cu ``ScaleBufferCudaImpl``).  The TPU
+equivalent of that memory-bound step is the post-ppermute combine
+
+    out = w_0 * x + w_1 * r_1 + ... + w_k * r_k
+
+which this kernel performs in a single VMEM pass over all k+1 operands:
+one read of each input tile, one write of the output tile, accumulation in
+f32 regardless of payload dtype.
+
+Measured reality (see ``bench_combine`` and docs/performance.md): XLA
+already fuses the equivalent ``jnp`` multiply-add chain into one HBM pass,
+so this kernel is a parity alternative, not a win — it exists to keep a
+hand-tuned escape hatch for combine variants XLA cannot fuse (and as the
+documented counterpart of the reference's CUDA kernel).  The collective
+layer uses the XLA path by default; set ``BLUEFOG_FUSED_COMBINE=pallas``
+to route :func:`bluefog_tpu.parallel.collectives.neighbor_allreduce`'s
+static-weight combine through this kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_weighted_combine", "bench_combine"]
+
+
+def _kernel(w_ref, *refs):
+    *in_refs, o_ref = refs
+    acc = in_refs[0][...].astype(jnp.float32) * w_ref[0]
+    for i, r in enumerate(in_refs[1:], start=1):
+        acc = acc + r[...].astype(jnp.float32) * w_ref[i]
+    o_ref[...] = acc.astype(o_ref.dtype)
+
+
+def fused_weighted_combine(
+    x: jax.Array,
+    received: Sequence[jax.Array],
+    weights: jax.Array,
+    block_rows: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """``weights[0] * x + sum_i weights[1+i] * received[i]`` in one pass.
+
+    ``weights`` is a traced f32 vector of length ``1 + len(received)`` (so
+    one compiled kernel serves every rank's weight values).  Inputs of any
+    shape/dtype; accumulation in f32 (the reference reduces in fp32 torch
+    ops, torch/mpi_ops.cc:119-155).  Differentiable: the op is linear, so
+    the VJP is exact (pallas_call itself has no autodiff rule).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _combine_vjp(x, tuple(received), jnp.asarray(weights, jnp.float32),
+                        block_rows, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _combine_vjp(x, received, weights, block_rows, interpret):
+    return _combine_impl(x, received, weights, block_rows, interpret)
+
+
+def _combine_fwd(x, received, weights, block_rows, interpret):
+    out = _combine_impl(x, received, weights, block_rows, interpret)
+    return out, (x, received, weights)
+
+
+def _combine_bwd(block_rows, interpret, res, g):
+    x, received, weights = res
+    g32 = g.astype(jnp.float32)
+    dx = (g32 * weights[0]).astype(x.dtype)
+    drs = tuple((g32 * weights[1 + i]).astype(r.dtype)
+                for i, r in enumerate(received))
+    dw = jnp.stack(
+        [jnp.vdot(g32, a.astype(jnp.float32)) for a in (x, *received)])
+    return dx, drs, dw
+
+
+_combine_vjp.defvjp(_combine_fwd, _combine_bwd)
+
+
+def _combine_impl(x, received, weights, block_rows, interpret):
+    ins = [x, *received]
+    orig_shape, orig_dtype = x.shape, x.dtype
+    n = x.size
+    # collapse to 2D [rows, 128]-friendly layout; pad the tail block inside
+    # pallas (elementwise: lane garbage never crosses lanes)
+    lane = 128
+    rows = -(-n // lane)
+    if rows * lane == n:  # exact reshape, no copy
+        flat = [jnp.ravel(a).reshape(rows, lane) for a in ins]
+    else:  # ragged tail: pad (one extra copy; combine stays correct)
+        flat = [jnp.pad(jnp.ravel(a), (0, rows * lane - n)).reshape(rows, lane)
+                for a in ins]
+    block_rows = min(block_rows, rows)
+    grid = (-(-rows // block_rows),)
+    spec = pl.BlockSpec((block_rows, lane), lambda i: (i, 0))
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + [spec] * len(ins),
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((rows, lane), orig_dtype),
+        interpret=interpret,
+    )(weights, *flat)
+    return out.reshape(-1)[:n].reshape(orig_shape)
+
+
+def bench_combine(size: int = 25_000_000, k: int = 3, dtype=jnp.float32,
+                  iters: int = 20):
+    """Micro-benchmark: pallas fused combine vs the XLA-fused jnp chain.
+    Returns (pallas_ms, xla_ms)."""
+    import time
+
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(size), dtype)
+    rs = [jnp.asarray(rng.randn(size), dtype) for _ in range(k)]
+    w = jnp.asarray(rng.rand(k + 1), jnp.float32)
+
+    @jax.jit
+    def pallas_fn(x, rs, w):
+        return fused_weighted_combine(x, rs, w)
+
+    @jax.jit
+    def xla_fn(x, rs, w):
+        acc = x.astype(jnp.float32) * w[0]
+        for i, r in enumerate(rs):
+            acc = acc + r.astype(jnp.float32) * w[1 + i]
+        return acc.astype(x.dtype)
+
+    from bluefog_tpu.benchutil import device_fetch, fetch_overhead
+
+    def timeit(fn):
+        device_fetch(fn(x, rs, w)[0])  # compile + warm
+        rtt = fetch_overhead()
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(x, rs, w)
+        device_fetch(out[0])
+        return max(time.perf_counter() - t0 - rtt, 1e-9) / iters * 1e3
+
+    return timeit(pallas_fn), timeit(xla_fn)
