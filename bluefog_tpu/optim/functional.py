@@ -47,6 +47,7 @@ CommSpec = Union[Topology, DynamicTopology]
 
 __all__ = [
     "build_train_step",
+    "push_sum_weights",
     "rank_major",
     "rank_major_init",
     "rank_spec_tree",
@@ -109,6 +110,15 @@ def consensus_distance(params) -> jax.Array:
     return total / count
 
 
+def push_sum_weights(mesh: Mesh, axis_name: str = "bf") -> jax.Array:
+    """Rank-major push-sum weight vector (init 1 per rank) — pair it with
+    the base optimizer state for ``comm_mode='push_sum'``:
+    ``opt_state = (base_opt_state, push_sum_weights(mesh))``."""
+    n = mesh.shape[axis_name]
+    return jax.device_put(jnp.ones((n,), jnp.float32),
+                          NamedSharding(mesh, P(axis_name)))
+
+
 def _combine_fn(spec: CommSpec, axis_name: str,
                 hierarchical_local_size: Optional[int]) -> Callable:
     if hierarchical_local_size is not None:
@@ -150,6 +160,15 @@ def build_train_step(
       * ``"atc"``  — adapt-then-combine (reference _DistributedAdaptThenCombine)
       * ``"gradient_allreduce"`` — global gradient averaging (reference
         _DistributedOptimizer)
+      * ``"push_sum"`` — bias-corrected directed averaging (reference
+        _DistributedPushSumOptimizer, optimizers.py:1026-1177): column-
+        stochastic mix of the extended payload [params ‖ ps_weight], then
+        de-bias by the mixed weight.  The step's ``opt_state`` must be
+        ``(base_opt_state, push_sum_weights(mesh))``.  Only the topology's
+        edge structure is used — combine weights are replaced by the
+        uniform ``1/(out_degree+1)`` push scales (see
+        ``collectives.push_sum_mix``); hierarchical_local_size is not
+        supported in this mode.
       * ``"none"`` — no communication (pure local SGD)
 
     Exactly one of ``topology`` (static) or ``schedule`` (dynamic, indexed
@@ -159,18 +178,28 @@ def build_train_step(
     (params, opt_state, loss)`` — all rank-major, jit-compiled with
     params/opt_state donated.
     """
-    if comm_mode not in ("cta", "atc", "gradient_allreduce", "none"):
+    if comm_mode not in ("cta", "atc", "gradient_allreduce", "push_sum",
+                         "none"):
         raise ValueError(f"unknown comm_mode {comm_mode!r}")
-    needs_topo = comm_mode in ("cta", "atc")
+    needs_topo = comm_mode in ("cta", "atc", "push_sum")
     if needs_topo and (topology is None) == (schedule is None):
         raise ValueError(
             "neighbor modes need exactly one of topology= or schedule=")
+    if comm_mode == "push_sum" and hierarchical_local_size is not None:
+        raise ValueError(
+            "hierarchical_local_size is not supported with "
+            "comm_mode='push_sum' (flat rank-level push-sum only)")
 
     specs = list(schedule) if schedule is not None else (
         [topology] if topology is not None else [])
     branches = [
         _combine_fn(s, axis_name, hierarchical_local_size) for s in specs
     ]
+    ps_branches = [
+        (lambda spec: lambda op: C.push_sum_mix(op[0], op[1], spec,
+                                                axis_name))(s)
+        for s in specs
+    ] if comm_mode == "push_sum" else []
     k_comm = int(num_steps_per_communication)
 
     def combine(params, step):
@@ -188,6 +217,34 @@ def build_train_step(
             return lax.cond(step % k_comm == 0, run, lambda p: p, params)
         return run(params)
 
+    def combine_push_sum(params, ps, step):
+        def run(operand):
+            params, ps = operand
+            # Push-sum state is the BIASED pair (x, w) with readout
+            # z = x / w; we carry (z, w) so the user-visible params stay
+            # de-biased, and re-bias before every mix (x = z * w) — mixing
+            # z directly is only correct on doubly-stochastic graphs and
+            # diverges on general digraphs.  The whole re-bias -> mix ->
+            # de-bias round stays in f32 (push_sum_mix returns the
+            # accumulation dtype); one cast back at the end.
+            dtypes = jax.tree.map(lambda z: z.dtype, params)
+            biased = jax.tree.map(
+                lambda z: z.astype(jnp.float32) * ps, params)
+            if len(ps_branches) == 1:
+                mixed, mixed_ps = ps_branches[0]((biased, ps))
+            else:
+                mixed, mixed_ps = lax.switch(
+                    step % len(ps_branches), ps_branches, (biased, ps))
+            # de-bias: z = x / w (reference optimizers.py:1151-1155)
+            debiased = jax.tree.map(
+                lambda x, dt: (x / mixed_ps).astype(dt), mixed, dtypes)
+            return debiased, mixed_ps
+
+        if k_comm > 1:
+            return lax.cond(step % k_comm == 0, run, lambda op: op,
+                            (params, ps))
+        return run((params, ps))
+
     def per_rank_step(params, aux, opt_state, batch, step):
         if has_aux:
             (loss, new_aux), grads = jax.value_and_grad(
@@ -203,6 +260,12 @@ def build_train_step(
         if comm_mode == "gradient_allreduce":
             grads = jax.tree.map(
                 lambda g: C.allreduce(g, axis_name, average=True), grads)
+        if comm_mode == "push_sum":
+            base_state, ps = opt_state
+            params, ps = combine_push_sum(params, ps, step)
+            updates, base_state = optimizer.update(grads, base_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, new_aux, (base_state, ps), loss
         if comm_mode == "cta":
             params = combine(params, step)
         updates, opt_state = optimizer.update(grads, opt_state, params)

@@ -53,6 +53,7 @@ __all__ = [
     "neighbor_allreduce",
     "neighbor_allgather",
     "pair_gossip",
+    "push_sum_mix",
     "hierarchical_neighbor_allreduce",
     "machine_groups",
 ]
@@ -196,6 +197,66 @@ def pair_gossip(
     is_self = jnp.asarray([int(t) == i for i, t in enumerate(target_ranks)])[idx]
     out = jnp.where(is_self, x.astype(acc_dtype), out)
     return out.astype(x.dtype)
+
+
+def _push_sum_structure(spec: CommSpec):
+    """(out_degrees, filtered perms): only edges with nonzero combine
+    weight count as push-sum out-edges (a 0.0-weight edge in a
+    DynamicTopology is declared but carries nothing)."""
+    deg = np.zeros(spec.size, dtype=np.int64)
+    perms = []
+    for cls in spec.shift_classes:
+        pairs = tuple((src, dst) for src, dst in cls.perm
+                      if cls.recv_weights[dst] != 0.0)
+        if not pairs:
+            continue
+        perms.append(pairs)
+        for src, _ in pairs:
+            deg[src] += 1
+    return deg, perms
+
+
+def push_sum_mix(tree, ps_weight: jax.Array, spec: CommSpec,
+                 axis_name: str):
+    """One push-sum round: column-stochastic mixing of the extended payload.
+
+    Every rank j scales its payload (each leaf of ``tree`` and the scalar
+    ``ps_weight``) by ``a_j = 1 / (out_degree_j + 1)`` and pushes it along
+    every out-edge; receivers sum what arrives plus their own scaled
+    payload.  Columns of the implied mixing matrix sum to 1, which
+    preserves ``sum_i ps_weight_i == n`` — the associated-P invariant the
+    reference asserts (reference test/torch_win_ops_test.py:780-863; wire
+    path mpi_controller.cc:1665-1701, optimizers.py:1026-1177).
+
+    NOTE: only the topology's edge STRUCTURE is used; combine weights are
+    replaced by the uniform column-stochastic ``1/(out_degree+1)`` scales,
+    exactly like the reference's push-sum optimizer (optimizers.py:
+    1032-1035) — arbitrary weights are generally not column-stochastic and
+    would break the invariant.  Zero-weight edges do not count.
+
+    Mixing is performed in the accumulation dtype (f32 for low-precision
+    payloads) and RETURNED in it — push-sum state should stay
+    high-precision across rounds; callers cast once after de-biasing.
+
+    Returns ``(mixed_tree, mixed_ps)`` — still biased; de-bias with
+    ``z = x / ps`` (reference optimizers.py:1151-1155).
+    """
+    deg, perms = _push_sum_structure(spec)
+    idx = lax.axis_index(axis_name)
+    a = jnp.asarray(1.0 / (deg + 1.0), jnp.float32)[idx]
+
+    def mix_leaf(x):
+        acc_dtype = _accum_dtype(x.dtype)
+        scaled = x.astype(acc_dtype) * a
+        acc = scaled
+        for perm in perms:
+            # ppermute delivers zeros to ranks with no in-edge in this class
+            acc = acc + lax.ppermute(scaled, axis_name, perm)
+        return acc
+
+    mixed = jax.tree.map(mix_leaf, tree)
+    mixed_ps = mix_leaf(ps_weight)
+    return mixed, mixed_ps
 
 
 def machine_groups(size: int, local_size: int) -> list:
