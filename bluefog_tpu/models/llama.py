@@ -47,6 +47,21 @@ class LlamaConfig:
     attn_block_size: int = 512  # for blockwise mode
     sp_axis: Optional[str] = None  # mesh axis for ring mode
     remat: bool = False
+    # Compile the decoder stack as ONE nn.scan'd block instead of L unrolled
+    # copies: params gain a leading [n_layers] axis, trace/compile time goes
+    # O(L) -> O(1), and remat composes per scan step (the standard TPU
+    # recipe for deep LLMs; the reference has no analogue — torch eager
+    # re-executes Python per layer).
+    scan_layers: bool = False
+    remat_policy: str = "none"  # none | dots | everything (with remat)
+
+    def __post_init__(self):
+        valid = ("none", "dots", "everything")
+        if self.remat_policy not in valid:
+            raise ValueError(
+                f"remat_policy {self.remat_policy!r} not in {valid}")
+        if self.remat_policy != "none" and not self.remat:
+            raise ValueError("remat_policy requires remat=True")
 
     @property
     def head_dim(self) -> int:
@@ -161,6 +176,16 @@ class Block(nn.Module):
         return x
 
 
+class _ScanBlock(nn.Module):
+    """nn.scan adapter: Block with a (carry, out) return signature."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, pos_offset):
+        return Block(self.cfg, name="block")(x, pos_offset), None
+
+
 class Llama(nn.Module):
     cfg: LlamaConfig
 
@@ -173,11 +198,39 @@ class Llama(nn.Module):
             f"{cfg.max_seq_len}")
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
                      param_dtype=jnp.float32, name="tok_embeddings")(tokens)
-        block_cls = Block
-        if cfg.remat:
-            block_cls = nn.checkpoint(Block, static_argnums=())
-        for i in range(cfg.n_layers):
-            x = block_cls(cfg, name=f"layer_{i}")(x, pos_offset)
+        policies = {
+            "none": None,
+            "dots": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            "everything": jax.checkpoint_policies.nothing_saveable,
+        }
+        policy = policies[cfg.remat_policy]
+        if cfg.scan_layers:
+            # one compiled block, scanned n_layers times; params get a
+            # leading [n_layers] axis under "layers" — trace/compile cost
+            # stops growing with depth
+            body = _ScanBlock
+            if cfg.remat:
+                # prevent_cse=False: XLA's loop lowering already blocks the
+                # problematic CSE under scan; the default True would insert
+                # an opt-barrier per scanned layer
+                body = nn.checkpoint(body, static_argnums=(), policy=policy,
+                                     prevent_cse=False)
+            scan_cls = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,
+                length=cfg.n_layers,
+                metadata_params={nn.meta.PARTITION_NAME: None},
+            )
+            x, _ = scan_cls(cfg, name="layers")(x, pos_offset)
+        else:
+            block_cls = Block
+            if cfg.remat:
+                block_cls = nn.checkpoint(Block, static_argnums=(),
+                                          policy=policy)
+            for i in range(cfg.n_layers):
+                x = block_cls(cfg, name=f"layer_{i}")(x, pos_offset)
         x = RMSNorm(cfg.norm_eps, name="norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           param_dtype=jnp.float32, name="output")(x)

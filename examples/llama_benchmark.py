@@ -40,13 +40,18 @@ parser.add_argument("--dist-optimizer", default="neighbor_allreduce",
 parser.add_argument("--sp", type=int, default=1,
                     help="sequence-parallel ways (ring attention)")
 parser.add_argument("--attn-impl", default="xla", choices=["xla", "flash"])
+parser.add_argument("--scan-layers", action="store_true",
+                    help="nn.scan the decoder stack (O(1) compile in depth)")
+parser.add_argument("--remat-policy", default="none",
+                    choices=["none", "dots", "everything"])
 parser.add_argument("--num-warmup", type=int, default=3)
 parser.add_argument("--num-steps", type=int, default=10)
 args = parser.parse_args()
 
 
 def make_config():
-    base = dict(remat=True)
+    base = dict(remat=True, scan_layers=args.scan_layers,
+                remat_policy=args.remat_policy)
     if args.sp > 1:
         if args.attn_impl == "flash":
             raise SystemExit(

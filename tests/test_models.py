@@ -148,3 +148,58 @@ def test_vit_trains(bf_ctx):
         params, state = opt.step(params, grads, state)
     loss1, _ = grad_fn(params, bf.rank_sharded(x), bf.rank_sharded(y))
     assert float(loss1) < float(loss0)
+
+
+def test_llama_scan_layers_matches_loop():
+    """nn.scan'd decoder stack == unrolled loop on remapped params; remat
+    composes on top without changing values."""
+    import jax.tree_util as jtu
+
+    tokens = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(0), (2, 16), 0, 256))
+    cfg_loop = models.LlamaConfig.tiny(dtype=jnp.float32)
+    m_loop = models.Llama(cfg_loop)
+    p_loop = m_loop.init(jax.random.PRNGKey(1), tokens)
+    ref = m_loop.apply(p_loop, tokens)
+
+    lp = p_loop["params"]
+    stacked = jtu.tree_map(lambda *xs: jnp.stack(xs),
+                           lp["layer_0"], lp["layer_1"])
+    scan_params = {"params": {"tok_embeddings": lp["tok_embeddings"],
+                              "norm": lp["norm"], "output": lp["output"],
+                              "layers": {"block": stacked}}}
+    for overrides in [dict(scan_layers=True),
+                      dict(scan_layers=True, remat=True,
+                           remat_policy="dots")]:
+        cfg = models.LlamaConfig.tiny(dtype=jnp.float32, **overrides)
+        out = models.Llama(cfg).apply(scan_params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_llama_scan_with_ring_attention():
+    """scan_layers composes with sequence-parallel ring attention."""
+    n = 4
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32, scan_layers=True,
+                                  attn_mode="ring", sp_axis="sp")
+    cfg_full = models.LlamaConfig.tiny(dtype=jnp.float32, scan_layers=True)
+    t = 8 * n
+    tokens = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (2, t), 0, cfg.vocab_size))
+    m_full = models.Llama(cfg_full)
+    params = m_full.init(jax.random.PRNGKey(0), tokens)
+    ref = m_full.apply(params, tokens)
+
+    mesh = Mesh(np.array(jax.devices()[:n]), ("sp",))
+    t_local = t // n
+    m_ring = models.Llama(cfg)
+
+    def fwd(tokens_shard):
+        offset = jax.lax.axis_index("sp") * t_local
+        return m_ring.apply(params, tokens_shard, pos_offset=offset)
+
+    out = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+        check_vma=False))(tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
