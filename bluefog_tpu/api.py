@@ -302,23 +302,26 @@ def allgather_nonblocking(tensor, name: Optional[str] = None) -> int:
 
 def neighbor_allreduce(tensor, *, self_weight=None, src_weights=None,
                        dst_weights=None, enable_topo_check: bool = True,
+                       compress: Optional[str] = None,
                        name: Optional[str] = None) -> jax.Array:
     return synchronize(neighbor_allreduce_nonblocking(
         tensor, self_weight=self_weight, src_weights=src_weights,
         dst_weights=dst_weights, enable_topo_check=enable_topo_check,
-        name=name))
+        compress=compress, name=name))
 
 
 def neighbor_allreduce_nonblocking(tensor, *, self_weight=None,
                                    src_weights=None, dst_weights=None,
                                    enable_topo_check: bool = True,
+                                   compress: Optional[str] = None,
                                    name: Optional[str] = None) -> int:
     ctx = get_context()
     spec, _dynamic = ctx.resolve_neighbor_spec(
         self_weight, src_weights, dst_weights,
         enable_topo_check=enable_topo_check)
-    out = ctx.run_op(("neighbor_allreduce", spec.digest()),
-                     lambda x: C.neighbor_allreduce(x, spec, AXIS), tensor)
+    out = ctx.run_op(("neighbor_allreduce", spec.digest(), compress),
+                     lambda x: C.neighbor_allreduce(
+                         x, spec, AXIS, compress=compress), tensor)
     return ctx.register_handle(name, "neighbor_allreduce", out)
 
 

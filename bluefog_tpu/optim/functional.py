@@ -120,13 +120,15 @@ def push_sum_weights(mesh: Mesh, axis_name: str = "bf") -> jax.Array:
 
 
 def _combine_fn(spec: CommSpec, axis_name: str,
-                hierarchical_local_size: Optional[int]) -> Callable:
+                hierarchical_local_size: Optional[int],
+                compress: Optional[str] = None) -> Callable:
     if hierarchical_local_size is not None:
         return lambda tree: jax.tree.map(
             lambda p: C.hierarchical_neighbor_allreduce(
                 p, spec, hierarchical_local_size, axis_name), tree)
     return lambda tree: jax.tree.map(
-        lambda p: C.neighbor_allreduce(p, spec, axis_name), tree)
+        lambda p: C.neighbor_allreduce(p, spec, axis_name,
+                                       compress=compress), tree)
 
 
 def build_train_step(
@@ -144,6 +146,7 @@ def build_train_step(
     batch_specs: Any = None,
     donate: bool = True,
     has_aux: bool = False,
+    compress: Optional[str] = None,
 ) -> Callable:
     """Compile one decentralized SGD/optax step over ``mesh``.
 
@@ -174,6 +177,10 @@ def build_train_step(
     Exactly one of ``topology`` (static) or ``schedule`` (dynamic, indexed
     by ``step % len(schedule)`` via ``lax.switch``) for the neighbor modes.
 
+    ``compress="int8"`` quantizes the cta/atc combine's wire payload
+    (per-tensor absmax int8; see ``collectives.neighbor_allreduce``) —
+    4x less ICI/DCN traffic at ~0.4% relative error per exchange.
+
     Returns ``train_step(params, opt_state, batch, step) ->
     (params, opt_state, loss)`` — all rank-major, jit-compiled with
     params/opt_state donated.
@@ -189,11 +196,20 @@ def build_train_step(
         raise ValueError(
             "hierarchical_local_size is not supported with "
             "comm_mode='push_sum' (flat rank-level push-sum only)")
+    if compress is not None:
+        if compress != "int8":
+            raise ValueError(f"unknown compress mode {compress!r}")
+        if comm_mode not in ("cta", "atc") or hierarchical_local_size:
+            raise ValueError(
+                "compress= is only honored by the flat cta/atc combine "
+                f"(got comm_mode={comm_mode!r}, hierarchical_local_size="
+                f"{hierarchical_local_size!r})")
 
     specs = list(schedule) if schedule is not None else (
         [topology] if topology is not None else [])
     branches = [
-        _combine_fn(s, axis_name, hierarchical_local_size) for s in specs
+        _combine_fn(s, axis_name, hierarchical_local_size, compress)
+        for s in specs
     ]
     ps_branches = [
         (lambda spec: lambda op: C.push_sum_mix(op[0], op[1], spec,

@@ -102,10 +102,21 @@ def allgather(x: jax.Array, axis_name: str) -> jax.Array:
     return lax.all_gather(x, axis_name, axis=0, tiled=True)
 
 
+def _wire_quantize_int8(x: jax.Array):
+    """Per-tensor absmax int8 quantization for the ppermute payload:
+    4x (f32) / 2x (bf16) fewer bytes on the ICI/DCN wire."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x32)) / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(x32 / safe), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def neighbor_allreduce(
     x: jax.Array,
     spec: CommSpec,
     axis_name: str,
+    compress: Optional[str] = None,
 ) -> jax.Array:
     """Weighted neighbor averaging — THE BlueFog primitive.
 
@@ -115,14 +126,33 @@ def neighbor_allreduce(
     torch/mpi_ops.cc:99-164; wire path mpi_controller.cc:419-745.
     One ``lax.ppermute`` per shift class; weights gathered per-rank via
     ``lax.axis_index``.
+
+    ``compress="int8"`` quantizes the ppermuted payload (per-tensor absmax
+    int8 + one f32 scale per neighbor) — the wire-level counterpart of the
+    reference's gradient compressor (reference compressor/Compressor.py),
+    made TPU-native by riding the collective itself.  The self term stays
+    full precision; max relative error per received tensor is
+    ~0.4% of its absmax.
     """
+    if compress not in (None, "int8"):
+        raise ValueError(f"unknown compress mode {compress!r}")
     acc_dtype = _accum_dtype(x.dtype)
     idx = lax.axis_index(axis_name)
     self_w = jnp.asarray(_self_weights_of(spec), dtype=acc_dtype)[idx]
     received, weights = [], [self_w]
-    for cls in spec.shift_classes:
-        received.append(lax.ppermute(x, axis_name, cls.perm))
-        weights.append(jnp.asarray(cls.recv_weights, dtype=acc_dtype)[idx])
+    if compress == "int8":
+        q, scale = _wire_quantize_int8(x)
+        for cls in spec.shift_classes:
+            rq = lax.ppermute(q, axis_name, cls.perm)
+            rs = lax.ppermute(scale, axis_name, cls.perm)
+            received.append(rq.astype(jnp.float32) * rs)
+            weights.append(
+                jnp.asarray(cls.recv_weights, dtype=acc_dtype)[idx])
+    else:
+        for cls in spec.shift_classes:
+            received.append(lax.ppermute(x, axis_name, cls.perm))
+            weights.append(
+                jnp.asarray(cls.recv_weights, dtype=acc_dtype)[idx])
     if (received and _FUSED_COMBINE == "pallas"
             and acc_dtype != jnp.dtype(jnp.float64)):
         # hand-tuned single-pass kernel (SURVEY §7.9a); measured at parity
