@@ -84,17 +84,51 @@ class Checkpointer:
             jax.tree.map(spec_of, item,
                          is_leaf=lambda x: hasattr(x, "shape")))
 
-    def restore(self, step: int, mesh: Optional[Mesh] = None) -> Any:
+    def restore(self, step: int, mesh: Optional[Mesh] = None,
+                like: Any = None) -> Any:
         """Restore the pytree saved at ``step``; with ``mesh``, leaves come
-        back sharded over the rank axis (otherwise host-local arrays)."""
-        return self._mgr.restore(step, args=self._restore_args(step, mesh))
+        back sharded over the rank axis (otherwise host-local arrays).
 
-    def restore_latest(self, mesh: Optional[Mesh] = None) -> Any:
+        ``like``: an example pytree with the ORIGINAL container types
+        (optax NamedTuple states etc.) — without it orbax returns plain
+        dict/list containers, which optax transformations reject.  Leaf
+        shapes/dtypes come from ``like``; array leaves are placed on the
+        rank sharding (scalars replicate) when ``mesh`` is given.
+        """
+        if like is None:
+            return self._mgr.restore(step, args=self._restore_args(step, mesh))
+        if mesh is not None:
+            n = mesh.shape[self.axis_name]
+            rank_sh = NamedSharding(mesh, P(self.axis_name))
+            repl_sh = NamedSharding(mesh, P())
+
+            def spec_of(leaf):
+                if not hasattr(leaf, "dtype"):  # python scalars round-trip
+                    return leaf
+                shape = tuple(np.shape(leaf))
+                if not shape:  # scalar leaves (step counters) replicate
+                    return jax.ShapeDtypeStruct(shape, leaf.dtype,
+                                                sharding=repl_sh)
+                if shape[0] != n:  # same contract as the like=None path
+                    raise ValueError(
+                        f"template leaf has rank axis {shape[0]} but the "
+                        f"mesh has {n} ranks; resume on a matching "
+                        f"'{self.axis_name}' axis size")
+                return jax.ShapeDtypeStruct(shape, leaf.dtype,
+                                            sharding=rank_sh)
+
+            template = jax.tree.map(spec_of, like)
+        else:
+            template = like
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+
+    def restore_latest(self, mesh: Optional[Mesh] = None,
+                       like: Any = None) -> Any:
         step = self.latest_step()
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory}")
-        return self.restore(step, mesh)
+        return self.restore(step, mesh, like=like)
 
     def close(self):
         self._mgr.close()

@@ -217,6 +217,8 @@ class DataLoader:
                 self._fields, batch_size, depth=prefetch_depth)
         self.native = use_native
         self._epoch_next = 0
+        self._skip_next = 0   # batches to fast-forward on the next epoch
+        self._cur = None      # (epoch, batches consumed) while iterating
 
     @property
     def sampler(self) -> DistributedSampler:
@@ -230,7 +232,9 @@ class DataLoader:
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, ...]]:
         epoch = self._epoch_next
-        self._epoch_next += 1
+        self._epoch_next = epoch + 1
+        skip = self._skip_next
+        self._skip_next = 0
         order = self._sampler.indices(epoch)
         if self.drop_last:
             order = order[:len(order) - len(order) % self.batch_size]
@@ -242,13 +246,20 @@ class DataLoader:
             pad = self.world - len(order) % self.world
             order = np.resize(order, len(order) + pad)  # tiles if pad > len
         self._pipe.start_epoch(order)
+        self._cur = {"epoch": epoch, "batch": skip}
+        consumed = 0
         while True:
             item = self._pipe.next()
             if item is None:
                 break
             slot, views = item
+            if consumed < skip:  # fast-forward a resumed mid-epoch position
+                self._pipe.release(slot)
+                consumed += 1
+                continue
             batch = tuple(v.copy() for v in views)
             self._pipe.release(slot)
+            consumed += 1
             if self.rank_major:
                 per = batch[0].shape[0] // self.world
                 batch = tuple(
@@ -256,7 +267,23 @@ class DataLoader:
                     for b in batch)
             if self._transform is not None:
                 batch = self._transform(*batch)
+            self._cur = {"epoch": epoch, "batch": consumed}
             yield batch
+        self._cur = None
+
+    def state_dict(self) -> dict:
+        """Resumable loader position: the in-progress epoch and how many of
+        its batches have been yielded (0 at an epoch boundary).  Save it
+        alongside the train state; after ``load_state_dict`` the next
+        iteration fast-forwards to exactly that position, so a restored
+        job replays the same batch stream."""
+        if self._cur is not None:
+            return dict(self._cur)
+        return {"epoch": self._epoch_next, "batch": 0}
+
+    def load_state_dict(self, state: dict):
+        self._epoch_next = int(state["epoch"])
+        self._skip_next = int(state.get("batch", 0))
 
     def close(self):
         self._pipe.close()

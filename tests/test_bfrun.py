@@ -136,3 +136,37 @@ def test_two_process_job(tmp_path):
                  sys.executable, str(script))
     assert out.returncode == 0, out.stdout + out.stderr
     assert out.stdout.count("consensus OK") == 2, out.stdout
+
+
+def test_two_process_hierarchical_machine_ops(tmp_path):
+    """2 processes x 4 devices = 2 'machines': hierarchical neighbor
+    averaging runs the intra-machine psum over each process's devices
+    (ICI-shaped) and the machine exchange across the process boundary
+    (DCN-shaped) — the pod topology of SURVEY §5's hierarchical path."""
+    script = tmp_path / "hier.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import bluefog_tpu as bf
+        import jax
+        from bluefog_tpu.topology import RingGraph
+
+        bf.init()
+        assert jax.process_count() == 2
+        n = bf.size()
+        assert bf.machine_size() == 2, bf.machine_size()
+        assert bf.local_size() == 4, bf.local_size()
+        bf.set_machine_topology(RingGraph(bf.machine_size()))
+        x = bf.from_rank_values(lambda r: np.full((4,), float(r)))
+        out = bf.hierarchical_neighbor_allreduce(x)
+        vals = np.stack(bf.to_rank_values(out))
+        # machine means: m0 ranks 0-3 -> 1.5, m1 ranks 4-7 -> 5.5; ring(2)
+        # averaging of machine means -> (1.5 + 5.5) / 2 = 3.5 everywhere
+        np.testing.assert_allclose(vals, 3.5, atol=1e-6)
+        print(f"proc {jax.process_index()} hier OK")
+    """))
+    port = _free_port()
+    out = _bfrun("-np", "2", "--force-cpu-devices", "4",
+                 "--coordinator", f"127.0.0.1:{port}",
+                 sys.executable, str(script))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("hier OK") == 2, out.stdout

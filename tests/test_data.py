@@ -243,3 +243,34 @@ def test_loader_world_defaults_to_bluefog_size(bf_ctx):
     bx, = next(iter(loader))
     assert bx.shape == (bf.size(), 16 // bf.size(), 2)
     loader.close()
+
+
+def test_loader_state_dict_mid_epoch_resume():
+    """state_dict mid-epoch + load_state_dict on a fresh loader resumes the
+    exact batch stream (review finding: epoch-granular state silently
+    dropped the in-progress epoch's remainder)."""
+    x = np.arange(40, dtype=np.float32).reshape(40, 1)
+    ref = DataLoader([x], batch_size=8, seed=3, world=1)
+    it = iter(ref)
+    consumed = [next(it), next(it)]  # 2 of 5 batches of epoch 0
+    state = ref.state_dict()
+    assert state == {"epoch": 0, "batch": 2}
+    rest_ref = list(it) + list(ref)  # remainder of epoch 0 + all of epoch 1
+
+    fresh = DataLoader([x], batch_size=8, seed=3, world=1)
+    fresh.load_state_dict(state)
+    rest = list(fresh) + list(fresh)
+    assert len(rest) == len(rest_ref)
+    for (a,), (b,) in zip(rest, rest_ref):
+        np.testing.assert_array_equal(a, b)
+    ref.close()
+    fresh.close()
+
+
+def test_loader_state_dict_epoch_boundary():
+    x = np.zeros((16, 1), np.float32)
+    loader = DataLoader([x], batch_size=8, world=1)
+    assert loader.state_dict() == {"epoch": 0, "batch": 0}
+    list(loader)
+    assert loader.state_dict() == {"epoch": 1, "batch": 0}
+    loader.close()
