@@ -58,31 +58,29 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def _leaf_spec(self, shape, dtype, mesh: Mesh):
+        """Shared leaf policy: scalars replicate; everything else must be
+        rank-major over the mesh (checked, with a clear error)."""
+        n = mesh.shape[self.axis_name]
+        shape = tuple(shape)
+        if not shape:  # scalar leaves (step counters etc.) replicate
+            return jax.ShapeDtypeStruct(shape, dtype,
+                                        sharding=NamedSharding(mesh, P()))
+        if shape[0] != n:
+            raise ValueError(
+                f"checkpoint leaf has rank axis {shape[0]} but the mesh "
+                f"has {n} ranks; resume on a matching '{self.axis_name}' "
+                "axis size")
+        return jax.ShapeDtypeStruct(
+            shape, dtype, sharding=NamedSharding(mesh, P(self.axis_name)))
+
     def _restore_args(self, step: int, mesh: Optional[Mesh]):
         if mesh is None:
             return ocp.args.StandardRestore()
         item = self._mgr.item_metadata(step)
-        n = mesh.shape[self.axis_name]
-        sharding = NamedSharding(mesh, P(self.axis_name))
-
-        replicated = NamedSharding(mesh, P())
-
-        def spec_of(meta):
-            shape = tuple(meta.shape)
-            if not shape:
-                # scalar leaves (step counters etc.) replicate
-                return jax.ShapeDtypeStruct(shape, meta.dtype,
-                                            sharding=replicated)
-            if shape[0] != n:
-                raise ValueError(
-                    f"checkpoint leaf has rank axis {shape[0]} but the mesh "
-                    f"has {n} ranks; resume on a matching '{self.axis_name}' "
-                    "axis size")
-            return jax.ShapeDtypeStruct(shape, meta.dtype, sharding=sharding)
-
         return ocp.args.StandardRestore(
-            jax.tree.map(spec_of, item,
-                         is_leaf=lambda x: hasattr(x, "shape")))
+            jax.tree.map(lambda m: self._leaf_spec(m.shape, m.dtype, mesh),
+                         item, is_leaf=lambda x: hasattr(x, "shape")))
 
     def restore(self, step: int, mesh: Optional[Mesh] = None,
                 like: Any = None) -> Any:
@@ -98,24 +96,10 @@ class Checkpointer:
         if like is None:
             return self._mgr.restore(step, args=self._restore_args(step, mesh))
         if mesh is not None:
-            n = mesh.shape[self.axis_name]
-            rank_sh = NamedSharding(mesh, P(self.axis_name))
-            repl_sh = NamedSharding(mesh, P())
-
             def spec_of(leaf):
                 if not hasattr(leaf, "dtype"):  # python scalars round-trip
                     return leaf
-                shape = tuple(np.shape(leaf))
-                if not shape:  # scalar leaves (step counters) replicate
-                    return jax.ShapeDtypeStruct(shape, leaf.dtype,
-                                                sharding=repl_sh)
-                if shape[0] != n:  # same contract as the like=None path
-                    raise ValueError(
-                        f"template leaf has rank axis {shape[0]} but the "
-                        f"mesh has {n} ranks; resume on a matching "
-                        f"'{self.axis_name}' axis size")
-                return jax.ShapeDtypeStruct(shape, leaf.dtype,
-                                            sharding=rank_sh)
+                return self._leaf_spec(np.shape(leaf), leaf.dtype, mesh)
 
             template = jax.tree.map(spec_of, like)
         else:

@@ -245,18 +245,17 @@ class DataLoader:
             # pad-for-equal-shards rule as DistributedSampler
             pad = self.world - len(order) % self.world
             order = np.resize(order, len(order) + pad)  # tiles if pad > len
-        self._pipe.start_epoch(order)
+        # fast-forward a resumed mid-epoch position in O(1): batches are
+        # consecutive chunks of ``order`` (slice AFTER trim/pad so batch
+        # boundaries stay identical to the uninterrupted epoch)
+        self._pipe.start_epoch(order[skip * self.batch_size:])
         self._cur = {"epoch": epoch, "batch": skip}
-        consumed = 0
+        consumed = skip
         while True:
             item = self._pipe.next()
             if item is None:
                 break
             slot, views = item
-            if consumed < skip:  # fast-forward a resumed mid-epoch position
-                self._pipe.release(slot)
-                consumed += 1
-                continue
             batch = tuple(v.copy() for v in views)
             self._pipe.release(slot)
             consumed += 1
@@ -276,10 +275,18 @@ class DataLoader:
         its batches have been yielded (0 at an epoch boundary).  Save it
         alongside the train state; after ``load_state_dict`` the next
         iteration fast-forwards to exactly that position, so a restored
-        job replays the same batch stream."""
+        job replays the same batch stream.
+
+        The position counts batches YIELDED by this loader — if a
+        lookahead wrapper (e.g. ``device_prefetch``) sits between the
+        loader and the train step, the count runs ahead of what was
+        trained on; checkpoint loader state only when iterating the loader
+        directly (or account for the wrapper's depth)."""
         if self._cur is not None:
             return dict(self._cur)
-        return {"epoch": self._epoch_next, "batch": 0}
+        # not mid-iteration: a loaded-but-not-yet-resumed position must
+        # round-trip (saving right after restore is a common startup path)
+        return {"epoch": self._epoch_next, "batch": self._skip_next}
 
     def load_state_dict(self, state: dict):
         self._epoch_next = int(state["epoch"])
@@ -301,6 +308,10 @@ def device_prefetch(iterator, sharding=None, depth: int = 2):
     Wraps any host-batch iterator; each element (tuple of arrays) is
     ``jax.device_put`` (with ``sharding`` if given) while the previous
     batch is still being consumed, overlapping H2D transfer with compute.
+
+    NOTE: this wrapper pulls ``depth`` batches ahead, so a wrapped
+    ``DataLoader``'s ``state_dict()`` counts batches the trainer has not
+    consumed yet — see ``DataLoader.state_dict``.
     """
     import collections
 

@@ -42,8 +42,9 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
     attn_mode: str = "full"  # full | blockwise | ring
-    attn_impl: str = "xla"  # xla | flash (Pallas kernel; ring+flash is
-    #                         forward-only — see parallel/ring_attention.py)
+    attn_impl: str = "xla"  # xla | flash (Pallas kernel; composes with
+    #                         attn_mode="ring" incl. training — the ring
+    #                         VJP re-runs the Pallas bwd per ring step)
     attn_block_size: int = 512  # for blockwise mode
     sp_axis: Optional[str] = None  # mesh axis for ring mode
     remat: bool = False

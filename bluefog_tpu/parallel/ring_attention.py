@@ -98,9 +98,10 @@ def ring_attention(
 
     impl="flash" runs each per-step block attention as the Pallas flash
     kernel (bluefog_tpu.parallel.pallas_attention) and merges partial
-    outputs via their log-sum-exp residuals.  Forward-only for now (the
-    Pallas path has no ring-level VJP); use the default "xla" impl for
-    training.
+    outputs via their log-sum-exp residuals; the custom ring-level VJP
+    re-runs the Pallas backward kernels per ring step against the global
+    (out, lse) residuals, so flash is fully trainable under sequence
+    parallelism.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -108,8 +109,7 @@ def ring_attention(
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if impl == "flash":
-        return _ring_flash(q, k, v, idx, axis_name, causal, scale, n,
-                           t_local)
+        return _ring_flash(q, k, v, axis_name, causal, scale, n, t_local)
 
     q_offset = idx * t_local
     m0 = jnp.full((b, n_heads, t_local), _NEG_INF, jnp.float32)
@@ -149,16 +149,14 @@ def ring_attention(
 from functools import partial as _partial
 
 
-@_partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _ring_flash(q, k, v, idx, axis_name, causal, scale, n, t_local):
-    """Ring attention over the Pallas flash kernel: per step the kernel
+def _ring_flash_impl(q, k, v, axis_name, causal, scale, n, t_local):
+    """Forward ring over the Pallas flash kernel: per step the kernel
     returns (out_s, lse_s); partials merge with logsumexp weights, so the
-    full softmax is exact.  custom_vjp wraps the WHOLE ring (not just the
-    output): differentiation must never trace into the Pallas call — its
-    jvp rule fails with an opaque assertion — so the bwd raises a clear
-    NotImplementedError instead."""
+    full softmax is exact.  Returns (out_f32, lse) — lse is the residual
+    the backward needs."""
     from bluefog_tpu.parallel.pallas_attention import flash_attention_with_lse
 
+    idx = lax.axis_index(axis_name)
     q_offset = idx * t_local
     shift = [(i, (i + 1) % n) for i in range(n)]
 
@@ -186,19 +184,75 @@ def _ring_flash(q, k, v, idx, axis_name, causal, scale, n, t_local):
         o, lse = step(s, k_blk, v_blk, o, lse)
         return (k_blk, v_blk, o, lse), None
 
-    (_, _, o, _), _ = lax.scan(body, (k, v, o, lse), jnp.arange(1, n))
+    (_, _, o, lse), _ = lax.scan(body, (k, v, o, lse), jnp.arange(1, n))
+    return o, lse
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, causal, scale, n, t_local):
+    """custom_vjp wraps the WHOLE ring (not just one kernel call):
+    differentiation must never trace into the Pallas forward — the
+    backward re-runs the Pallas bwd kernels per ring step instead."""
+    o, _ = _ring_flash_impl(q, k, v, axis_name, causal, scale, n, t_local)
     return o.astype(q.dtype)
 
 
-def _ring_flash_fwd(q, k, v, idx, axis_name, causal, scale, n, t_local):
-    return _ring_flash(q, k, v, idx, axis_name, causal, scale, n,
-                       t_local), None
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, n, t_local):
+    o, lse = _ring_flash_impl(q, k, v, axis_name, causal, scale, n, t_local)
+    out = o.astype(q.dtype)
+    return out, (q, k, v, out, lse)
 
 
 def _ring_flash_bwd(axis_name, causal, scale, n, t_local, res, g):
-    raise NotImplementedError(
-        "ring_attention(impl='flash') is forward-only — the Pallas path has "
-        "no ring-level VJP yet. Use impl='xla' for training.")
+    """Ring backward: each step runs the Pallas backward kernels for one
+    (Q, K/V-block) pair against the GLOBAL (out, lse) residuals — the
+    per-block probabilities exp(S - lse) are then exactly the global
+    softmax slices, so per-block (dQ, dK, dV) contributions sum to the
+    exact gradients.  dK/dV accumulators rotate around the ring WITH their
+    K/V block; after the final step one more ppermute delivers every
+    accumulator back to its home rank."""
+    from bluefog_tpu.parallel.pallas_attention import (
+        _auto_interpret,
+        _flash_bwd_impl,
+    )
+
+    q, k, v, out, lse = res
+    interpret = _auto_interpret(None)
+    idx = lax.axis_index(axis_name)
+    q_offset = idx * t_local
+    shift = [(i, (i + 1) % n) for i in range(n)]
+
+    def block_grads(s, k_blk, v_blk):
+        kv_offset = ((idx - s) % n) * t_local
+        return _flash_bwd_impl(
+            q, k_blk, v_blk, out, lse, g, q_offset, kv_offset,
+            causal=causal, scale=scale, block_q=512, block_k=512,
+            interpret=interpret)
+
+    dq_c, dk_c, dv_c = block_grads(0, k, v)
+    dq = dq_c.astype(jnp.float32)
+    dk = dk_c.astype(jnp.float32)
+    dv = dv_c.astype(jnp.float32)
+
+    def body(carry, s):
+        k_blk, v_blk, dq, dk, dv = carry
+        k_blk = lax.ppermute(k_blk, axis_name, shift)
+        v_blk = lax.ppermute(v_blk, axis_name, shift)
+        dk = lax.ppermute(dk, axis_name, shift)
+        dv = lax.ppermute(dv, axis_name, shift)
+        dq_c, dk_c, dv_c = block_grads(s, k_blk, v_blk)
+        dq = dq + dq_c.astype(jnp.float32)
+        dk = dk + dk_c.astype(jnp.float32)
+        dv = dv + dv_c.astype(jnp.float32)
+        return (k_blk, v_blk, dq, dk, dv), None
+
+    (_, _, dq, dk, dv), _ = lax.scan(
+        body, (k, v, dq, dk, dv), jnp.arange(1, n))
+    # the carried block now originated at rank idx+1; one final rotation
+    # brings each dK/dV accumulator home
+    dk = lax.ppermute(dk, axis_name, shift)
+    dv = lax.ppermute(dv, axis_name, shift)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)

@@ -53,11 +53,8 @@ def make_config():
     base = dict(remat=True, scan_layers=args.scan_layers,
                 remat_policy=args.remat_policy)
     if args.sp > 1:
-        if args.attn_impl == "flash":
-            raise SystemExit(
-                "--sp > 1 with --attn-impl flash is not supported for "
-                "training (ring+flash has no VJP); use --attn-impl xla")
-        base.update(attn_mode="ring", sp_axis="sp")
+        base.update(attn_mode="ring", sp_axis="sp",
+                    attn_impl=args.attn_impl)
     elif args.attn_impl == "flash":
         base.update(attn_impl="flash")
     if args.model == "tiny":

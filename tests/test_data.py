@@ -274,3 +274,13 @@ def test_loader_state_dict_epoch_boundary():
     list(loader)
     assert loader.state_dict() == {"epoch": 1, "batch": 0}
     loader.close()
+
+
+def test_loader_state_dict_roundtrips_after_restore():
+    """Saving right after load_state_dict (before any batch) must not
+    rewind the position (review finding)."""
+    x = np.zeros((40, 1), np.float32)
+    loader = DataLoader([x], batch_size=8, seed=3, world=1)
+    loader.load_state_dict({"epoch": 1, "batch": 3})
+    assert loader.state_dict() == {"epoch": 1, "batch": 3}
+    loader.close()

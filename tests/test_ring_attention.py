@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bluefog_tpu.parallel.ring_attention import (
     blockwise_attention,
@@ -124,3 +124,91 @@ def test_ring_gradients_match_full():
     for gr, gf in zip(g_ring, g_full):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_ring_flash_backward_matches_full():
+    """The ring-level Pallas VJP: gradients of sequence-sharded
+    ring+flash attention == gradients of dense single-device attention
+    (dQ, dK, dV, all GQA-narrow)."""
+    n = 4
+    b, t, h, h_kv, d = 2, 32, 4, 2, 16
+    t_local = t // n
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, h_kv, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, h_kv, d), jnp.float32)
+    g = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) * g)
+
+    ref_grads = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+
+    mesh = Mesh(np.array(jax.devices()[:n]), ("sp",))
+
+    def ring_loss(q, k, v, g):
+        # local per-shard loss: the global loss is the implicit sum over
+        # shards, and reverse-mode routes cross-shard dK/dV cotangents
+        # through the ppermute VJPs (psum-ing here would double-count —
+        # psum's VJP is psum, scaling every cotangent by n)
+        out = ring_attention(q, k, v, "sp", causal=True, impl="flash")
+        return jnp.sum(out * g)
+
+    def shard_grads(q, k, v, g):
+        return jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v, g)
+
+    spec = P(None, "sp")
+    out_grads = jax.jit(jax.shard_map(
+        shard_grads, mesh=mesh, in_specs=(spec,) * 4,
+        out_specs=(spec,) * 3, check_vma=False))(q, k, v, g)
+    for got, ref in zip(out_grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ring_flash_trains_in_llama():
+    """End-to-end: a tiny ring+flash Llama takes a training step under
+    dp x sp without error and the loss decreases."""
+    import optax
+    from bluefog_tpu.optim import functional as F
+    from bluefog_tpu.context import _uniform_topology_spec
+    from bluefog_tpu.topology.graphs import RingGraph
+    from bluefog_tpu import models
+
+    n_dp, n_sp = 2, 4
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(n_dp, n_sp),
+                ("bf", "sp"))
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32, attn_mode="ring",
+                                  sp_axis="sp", attn_impl="flash")
+    model = models.Llama(cfg)
+    t_total = 32
+    t_local = t_total // n_sp
+    raw = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (n_dp, 2, t_total + 1)).astype(np.int32)
+    inputs, targets = raw[:, :, :-1], raw[:, :, 1:]
+
+    def loss_fn(params, batch):
+        inp, tgt = batch
+        logits = model.apply(params, inp,
+                             pos_offset=jax.lax.axis_index("sp") * t_local)
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, tgt))
+
+    spec = _uniform_topology_spec(RingGraph(n_dp))
+    step_fn = F.build_train_step(
+        loss_fn, optax.adam(1e-3), mesh, comm_mode="atc", topology=spec,
+        sp_axis="sp", batch_specs=P("bf", None, "sp"))
+    base = models.Llama(models.LlamaConfig.tiny(dtype=jnp.float32)).init(
+        jax.random.PRNGKey(0), inputs[0, :, :8])
+    params = F.rank_major(base, mesh)
+    opt_state = F.rank_major(optax.adam(1e-3).init(base), mesh)
+    sharding = NamedSharding(mesh, P("bf", None, "sp"))
+    batch = (jax.device_put(inputs, sharding),
+             jax.device_put(targets, sharding))
+    losses = []
+    for i in range(6):
+        params, opt_state, loss = step_fn(params, opt_state, batch,
+                                          jnp.int32(i))
+        losses.append(float(np.asarray(loss).mean()))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
