@@ -8,9 +8,11 @@ underneath (host round-trip through numpy).
 """
 
 from bluefog_tpu.interop.torch_adapter import (  # noqa: F401
+    DistributedOptimizer,
     TorchAdapter,
     allgather,
     allreduce,
     broadcast,
+    broadcast_parameters,
     neighbor_allreduce,
 )

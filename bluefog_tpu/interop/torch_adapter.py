@@ -84,12 +84,74 @@ def neighbor_allreduce(tensor, *, self_weight=None, src_weights=None,
         like=tensor)
 
 
+def broadcast_parameters(tensors, root_rank: int = 0):
+    """In-place: every rank's slice of each rank-major tensor becomes the
+    root rank's slice (reference tensorflow ``broadcast_variables`` /
+    torch ``broadcast_parameters``, torch/utility.py:26)."""
+    _require_torch()
+    with torch.no_grad():
+        for t in tensors:
+            t.copy_(broadcast(t, root_rank))
+
+
+class DistributedOptimizer:
+    """Wrap a ``torch.optim.Optimizer`` whose parameters are rank-major
+    ``[n_ranks, ...]`` replica stacks; communication runs over the
+    BlueFog-TPU data plane.
+
+    Mirrors the reference's second-framework optimizer surface
+    (reference tensorflow/optimizers.py DistributedOptimizer — gradient
+    allreduce) plus the decentralized flavor:
+
+    * ``communication="allreduce"``: average gradients globally before
+      the base step (Horovod-style).
+    * ``communication="neighbor_allreduce"``: take the base step, then
+      combine parameters with graph neighbors (ATC).
+    """
+
+    def __init__(self, optimizer, communication: str = "allreduce"):
+        _require_torch()
+        if communication not in ("allreduce", "neighbor_allreduce"):
+            raise ValueError(f"unknown communication {communication!r}")
+        self.optimizer = optimizer
+        self.communication = communication
+
+    def _params(self):
+        for group in self.optimizer.param_groups:
+            for p in group["params"]:
+                yield p
+
+    def step(self, closure=None):
+        with torch.no_grad():
+            if self.communication == "allreduce":
+                for p in self._params():
+                    if p.grad is not None:
+                        p.grad.copy_(allreduce(p.grad, average=True))
+        loss = self.optimizer.step(closure)
+        with torch.no_grad():
+            if self.communication == "neighbor_allreduce":
+                for p in self._params():
+                    p.data.copy_(neighbor_allreduce(p.data))
+        return loss
+
+    def zero_grad(self, *a, **kw):
+        return self.optimizer.zero_grad(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.optimizer, name)
+
+
 class TorchAdapter:
     """Module-style facade mirroring the reference's framework API object —
     the same reduced surface its TF binding exposes (allreduce, allgather,
-    broadcast; reference tensorflow/mpi_ops.py) plus neighbor_allreduce."""
+    broadcast, DistributedOptimizer, broadcast_variables; reference
+    tensorflow/mpi_ops.py, tensorflow/optimizers.py) plus
+    neighbor_allreduce."""
 
     allreduce = staticmethod(allreduce)
     allgather = staticmethod(allgather)
     broadcast = staticmethod(broadcast)
     neighbor_allreduce = staticmethod(neighbor_allreduce)
+    broadcast_parameters = staticmethod(broadcast_parameters)
+    broadcast_variables = staticmethod(broadcast_parameters)  # TF name
+    DistributedOptimizer = DistributedOptimizer

@@ -203,3 +203,20 @@ def test_llama_scan_with_ring_attention():
         check_vma=False))(tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_llama3_8b_flagship_traces():
+    """The flagship Llama-3-8B config (BASELINE.md stress target) traces
+    end-to-end with scan_layers — eval_shape only (no memory), proving the
+    full-scale graph builds: 8.0B params, [B, T, vocab] logits."""
+    cfg = models.LlamaConfig.llama3_8b(scan_layers=True, remat=True,
+                                       remat_policy="dots")
+    model = models.Llama(cfg)
+    tokens = jnp.zeros((1, 2048), jnp.int32)
+    var_shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), tokens))
+    n_params = sum(int(np.prod(s.shape))
+                   for s in jax.tree.leaves(var_shapes))
+    assert 7.9e9 < n_params < 8.2e9, n_params
+    out_shape = jax.eval_shape(model.apply, var_shapes, tokens)
+    assert tuple(out_shape.shape) == (1, 2048, cfg.vocab_size)
