@@ -16,6 +16,7 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from bluefog_tpu import config as bfconfig
@@ -295,8 +296,46 @@ def allgather(tensor, name: Optional[str] = None) -> jax.Array:
 
 
 def allgather_nonblocking(tensor, name: Optional[str] = None) -> int:
+    """Concatenate all ranks' tensors along dim 0.
+
+    Equal per-rank shapes take the direct ``all_gather`` path.  Variable
+    dim-0 sizes (reference allgatherv, mpi_controller.cc:136-168) are
+    accepted as a list/tuple of per-rank arrays: payloads are padded to the
+    max row count, gathered in one collective, and the pad rows dropped on
+    device by a static row-gather (see ``collectives.allgatherv``).
+    """
     ctx = get_context()
-    out = ctx.run_op(("allgather",), lambda x: C.allgather(x, AXIS), tensor)
+    if isinstance(tensor, (list, tuple)):
+        parts = [np.asarray(t) for t in tensor]
+        if len(parts) != ctx.size():
+            raise BluefogError(
+                f"variable-size allgather needs one tensor per rank "
+                f"({ctx.size()}), got {len(parts)}")
+        if any(p.ndim < 1 for p in parts):
+            raise BluefogError(
+                "variable-size allgather needs at least rank-1 tensors "
+                "(the concat axis is dim 0)")
+        trailing = {p.shape[1:] for p in parts}
+        if len(trailing) != 1:
+            raise BluefogError(
+                f"variable-size allgather: trailing dims must match, "
+                f"got {sorted(trailing)}")
+        dtypes = {p.dtype for p in parts}
+        if len(dtypes) != 1:
+            raise BluefogError(
+                f"variable-size allgather: dtypes must match, "
+                f"got {sorted(str(d) for d in dtypes)}")
+        sizes = tuple(p.shape[0] for p in parts)
+        pad = max(sizes) if sizes else 0
+        padded = np.zeros((len(parts), pad) + parts[0].shape[1:],
+                          dtype=parts[0].dtype)
+        for r, p in enumerate(parts):
+            padded[r, :p.shape[0]] = p
+        out = ctx.run_op(("allgatherv", sizes),
+                         lambda x: C.allgatherv(x, sizes, AXIS), padded)
+    else:
+        out = ctx.run_op(("allgather",), lambda x: C.allgather(x, AXIS),
+                         tensor)
     return ctx.register_handle(name, "allgather", out)
 
 
@@ -382,20 +421,20 @@ def neighbor_allgather_nonblocking(tensor, *, src_ranks=None, dst_ranks=None,
             "same time")
     if src_ranks is None:
         spec = ctx.topology_spec()
-        in_lists = {r: ctx.in_neighbor_ranks(r) for r in range(n)}
     else:
         from bluefog_tpu.context import WeightArg
         src_per = WeightArg.per_rank(src_ranks, n, "src")
         dst_per = WeightArg.per_rank(dst_ranks, n, "dst")
         edge_weights = {}
-        in_lists = {r: [] for r in range(n)}
         for dstr in range(n):
             entry = src_per[dstr] or []
             srcs = list(entry.keys()) if isinstance(entry, dict) else list(entry)
             for s in srcs:
+                if int(s) == dstr:
+                    raise BluefogError(
+                        f"neighbor_allgather src_ranks for rank {dstr} "
+                        "contains itself; self values are not gathered.")
                 edge_weights[(int(s), dstr)] = 1.0
-                in_lists[dstr].append(int(s))
-            in_lists[dstr].sort()
         # cross-check like enable_topo_check
         if enable_topo_check:
             for srcr in range(n):
@@ -408,25 +447,40 @@ def neighbor_allgather_nonblocking(tensor, *, src_ranks=None, dst_ranks=None,
                             "neighbor_allgather dynamic mode.")
         from bluefog_tpu.topology.spec import DynamicTopology
         spec = DynamicTopology.from_edges(n, edge_weights)
-    dense = ctx.run_op(("neighbor_allgather", spec.digest()),
-                       lambda x: C.neighbor_allgather(x, spec, AXIS), tensor)
-    # dense: [n(dst), n(src), d0, ...] -> ragged concat by sorted src
-    degs = {r: len(in_lists[r]) for r in in_lists}
-    uniform = len(set(degs.values())) == 1
+    # The kernel orders slots by the spec-derived sorted in-neighbor
+    # lists; use the same derivation here so finalize can never disagree
+    # with the kernel's slot layout.
+    in_lists = C.in_neighbor_lists(spec)
+    # Padded in-degree-sized kernel: per-shard memory O(max_in_degree*|x|)
+    # (the dense [n, ...] buffer would be O(n*|x|) per shard — O(n^2)
+    # total; the reference also allocates in-degree-sized output,
+    # mpi_controller.cc:282-361).  Slots are ordered by source rank.
+    padded = ctx.run_op(
+        ("neighbor_allgather_padded", spec.digest()),
+        lambda x: C.neighbor_allgather_padded(x, spec, AXIS), tensor)
+    uniform = len({len(l) for l in in_lists}) == 1
 
-    def finalize(dense_arr):
+    if uniform:
+        # [n, d, d0, ...] -> [n, d*d0, ...] on device: already the
+        # reference's concat-by-source layout.  No host round trip (and
+        # no jit: reshape on a committed array preserves the sharding).
+        out = padded.reshape((padded.shape[0],
+                              padded.shape[1] * padded.shape[2])
+                             + padded.shape[3:])
+        return ctx.register_handle(name, "neighbor_allgather", out)
+
+    def finalize(padded_arr):
         from bluefog_tpu.context import host_fetch
-        host = host_fetch(dense_arr)
+        host = host_fetch(padded_arr)
         per_rank = [
-            np.concatenate([host[r, s] for s in in_lists[r]], axis=0)
+            np.concatenate([host[r, k] for k in range(len(in_lists[r]))],
+                           axis=0)
             if in_lists[r] else host[r, :0].reshape((0,) + host.shape[3:])
             for r in range(n)
         ]
-        if uniform:
-            return ctx.rank_sharded(np.stack(per_rank))
         return per_rank
 
-    out = _LazyResult(dense, finalize)
+    out = _LazyResult(padded, finalize)
     return ctx.register_handle(name, "neighbor_allgather", out)
 
 

@@ -138,7 +138,13 @@ class DistributedOptimizer:
         return self.optimizer.zero_grad(*a, **kw)
 
     def __getattr__(self, name):
-        return getattr(self.optimizer, name)
+        # Guard against infinite recursion when 'optimizer' itself is
+        # missing (pickling/copy protocols probe dunders before __init__
+        # has run) — raise AttributeError instead of recursing.
+        if name == "optimizer" or (name.startswith("__")
+                                   and name.endswith("__")):
+            raise AttributeError(name)
+        return getattr(object.__getattribute__(self, "optimizer"), name)
 
 
 class TorchAdapter:

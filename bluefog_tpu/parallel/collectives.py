@@ -50,8 +50,11 @@ __all__ = [
     "allreduce",
     "broadcast",
     "allgather",
+    "allgatherv",
     "neighbor_allreduce",
     "neighbor_allgather",
+    "neighbor_allgather_padded",
+    "in_neighbor_lists",
     "pair_gossip",
     "push_sum_mix",
     "hierarchical_neighbor_allreduce",
@@ -195,6 +198,89 @@ def neighbor_allgather(
         src = (idx - cls.shift) % spec.size
         slot = jnp.where(mask > 0, received, jnp.zeros_like(received))
         out = lax.dynamic_update_index_in_dim(out, slot, src, 0)
+    return out
+
+
+def allgatherv(
+    x: jax.Array,
+    sizes: Sequence[int],
+    axis_name: str,
+) -> jax.Array:
+    """Variable-size allgather (reference allgatherv,
+    mpi_controller.cc:136-168 — gathers per-rank counts, computes
+    displacements, then ``MPI_Allgatherv``).
+
+    SPMD requires static shapes, so rank r's payload arrives padded to
+    ``max(sizes)`` rows along dim 0 (``x`` is the per-shard padded buffer);
+    ``sizes`` is the trace-time list of true per-rank row counts.  The
+    output is the exact ragged concatenation ``[sum(sizes), ...]`` — the
+    pad rows are dropped on device by one static row-gather (the
+    displacement computation, done at trace time instead of runtime).
+    """
+    sizes = [int(s) for s in sizes]
+    pad = x.shape[0]
+    if any(s > pad for s in sizes):
+        raise ValueError(f"sizes {sizes} exceed the padded row count {pad}")
+    gathered = lax.all_gather(x, axis_name, axis=0, tiled=True)
+    rows = np.concatenate(
+        [np.arange(s, dtype=np.int32) + r * pad
+         for r, s in enumerate(sizes)]) if sizes else np.zeros(0, np.int32)
+    return jnp.take(gathered, jnp.asarray(rows), axis=0)
+
+
+def in_neighbor_lists(spec: CommSpec) -> list:
+    """Sorted in-neighbor lists per rank, derived from the shift classes
+    (edges with nonzero recv weight).  Host-side, trace-time."""
+    lists: list = [[] for _ in range(spec.size)]
+    for cls in spec.shift_classes:
+        for dst in range(spec.size):
+            if cls.recv_weights[dst] != 0.0:
+                lists[dst].append((dst - cls.shift) % spec.size)
+    for l in lists:
+        l.sort()
+    return lists
+
+
+def neighbor_allgather_padded(
+    x: jax.Array,
+    spec: CommSpec,
+    axis_name: str,
+) -> jax.Array:
+    """In-degree-sized neighbor gather: shape ``[max_in_degree, *x.shape]``
+    per shard, slot ``k`` holding the value of the rank's k-th smallest
+    in-neighbor (zeros beyond the rank's own in-degree).
+
+    This is the scalable replacement for the dense ``[size, ...]`` buffer:
+    per-shard memory is O(in_degree * |x|) — the reference likewise
+    allocates in-degree-sized output (mpi_controller.cc:282-361).  Slot
+    positions vary per rank, so each shift class writes through a per-rank
+    slot table (a trace-time constant indexed by ``lax.axis_index``); for
+    graphs whose in-degree is uniform (every standard topology), the result
+    reshaped to ``[in_degree * d0, ...]`` IS the reference's
+    concat-by-source-rank layout (torch/mpi_ops.py:440-476) with no host
+    finalization at all.
+    """
+    n = spec.size
+    lists = in_neighbor_lists(spec)
+    d_max = max((len(l) for l in lists), default=0)
+    if d_max == 0:
+        return jnp.zeros((0,) + x.shape, x.dtype)
+    idx = lax.axis_index(axis_name)
+    out = jnp.zeros((d_max,) + x.shape, x.dtype)
+    for cls in spec.shift_classes:
+        received = lax.ppermute(x, axis_name, cls.perm)
+        slots = []
+        for dst in range(n):
+            if cls.recv_weights[dst] != 0.0:
+                slots.append(lists[dst].index((dst - cls.shift) % n))
+            else:
+                slots.append(-1)
+        slot = jnp.asarray(slots, jnp.int32)[idx]
+        has_edge = slot >= 0
+        safe = jnp.maximum(slot, 0)
+        current = lax.dynamic_index_in_dim(out, safe, 0, keepdims=True)
+        update = jnp.where(has_edge, received[None], current)
+        out = lax.dynamic_update_index_in_dim(out, update, safe, 0)
     return out
 
 

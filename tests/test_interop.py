@@ -76,7 +76,12 @@ def test_distributed_optimizer_trains_torch_model(bf_ctx, communication):
     for _ in range(150):
         opt.zero_grad()
         pred = torch.einsum("rsd,rd->rs", A, w)
-        loss = ((pred - b) ** 2).mean()
+        # Each rank's loss is the mean over ITS OWN 16 samples (a global
+        # mean would shrink per-rank grads by 1/n — the reference's
+        # DistributedOptimizer averages per-rank gradients, it does not
+        # rescale them).  Summing the per-rank means keeps each rank's
+        # gradient flowing only into its own replica slice.
+        loss = ((pred - b) ** 2).mean(dim=1).sum()
         loss.backward()
         opt.step()
     final = w.detach().numpy()

@@ -230,6 +230,25 @@ def test_neighbor_allreduce_self_src_must_pair(bf_ctx):
         bf.neighbor_allreduce(x, self_weight=0.5)
 
 
+def test_allgather_variable_size(bf_ctx):
+    """Reference torch_ops_test.py:322 (variable-size allgather): rank r
+    contributes r+1 rows; every rank gets the exact ragged concat."""
+    parts = [np.full((r + 1, 3), float(r), np.float32) for r in range(SIZE)]
+    out = bf.allgather(parts)
+    total = sum(r + 1 for r in range(SIZE))
+    assert out.shape == (SIZE, total, 3)
+    host = np.asarray(out)
+    expected = np.concatenate(parts)
+    for r in range(SIZE):
+        np.testing.assert_allclose(host[r], expected)
+
+
+def test_allgather_variable_size_rejects_mismatched_trailing(bf_ctx):
+    parts = [np.zeros((2, 3)) for _ in range(SIZE - 1)] + [np.zeros((2, 4))]
+    with pytest.raises(Exception, match="trailing"):
+        bf.allgather(parts)
+
+
 # ------------------------------------------------------------------ #
 # neighbor_allgather (reference :1116-1285)
 # ------------------------------------------------------------------ #

@@ -1,0 +1,106 @@
+"""Scale-proofing the gather family at 128 virtual ranks.
+
+The dense ``[size, ...]`` neighbor-gather buffer is O(n^2) total memory and
+OOMs at pod scale; ``collectives.neighbor_allgather_padded`` allocates
+in-degree-sized output like the reference (mpi_controller.cc:282-361).
+These tests run in a subprocess (the main suite pins 8 virtual devices in
+conftest.py) with 128 virtual CPU devices and check, via XLA's compile-time
+memory analysis, that at a tensor size where the dense buffer would exceed
+host RAM the padded kernel compiles to an in-degree-sized footprint — then
+execute the padded kernel at 128 ranks for value correctness.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = textwrap.dedent("""
+    import json, os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=128"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp, numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from bluefog_tpu.topology import graphs
+    from bluefog_tpu.topology.spec import uniform_topology_spec
+    from bluefog_tpu.parallel import collectives as C
+
+    N = 128
+    mesh = Mesh(np.array(jax.devices()), ("bf",))
+    graph = graphs.ExponentialTwoGraph(N)
+    spec = uniform_topology_spec(graph)
+
+    def sharded(kernel):
+        return jax.jit(jax.shard_map(
+            lambda x: kernel(x[0])[None], mesh=mesh, in_specs=P("bf"),
+            out_specs=P("bf"), check_vma=False))
+
+    # --- compile-time memory accounting at an OOM-scale tensor size ---
+    # 16 MB per rank: dense per-device output = 128 * 16 MB = 2 GB
+    # -> 256 GB across the pod (beyond this host's RAM); padded output is
+    # in-degree-sized (7 slots).
+    big = jax.ShapeDtypeStruct((N, 2048, 2048), jnp.float32)
+    pad_c = sharded(
+        lambda x: C.neighbor_allgather_padded(x, spec, "bf")).lower(
+            big).compile()
+    dense_c = sharded(
+        lambda x: C.neighbor_allgather(x, spec, "bf")).lower(big).compile()
+    pad_ma, dense_ma = pad_c.memory_analysis(), dense_c.memory_analysis()
+
+    # --- execution correctness at 128 ranks (modest size) ---
+    x = jax.device_put(
+        jnp.broadcast_to(jnp.arange(N, dtype=jnp.float32)[:, None, None],
+                         (N, 4, 2)), NamedSharding(mesh, P("bf")))
+    out = np.asarray(sharded(
+        lambda v: C.neighbor_allgather_padded(v, spec, "bf"))(x))
+    correct = True
+    for r in range(N):
+        nbrs = sorted(s for s in graph.predecessors(r) if s != r)
+        correct &= out.shape[1] == len(nbrs)
+        for k, s in enumerate(nbrs):
+            correct &= bool(np.allclose(out[r, k], s))
+
+    print(json.dumps({
+        "classes": len(spec.shift_classes),
+        "pad_out": pad_ma.output_size_in_bytes,
+        "pad_temp": pad_ma.temp_size_in_bytes,
+        "dense_out": dense_ma.output_size_in_bytes,
+        "exec_correct": correct,
+        "out_shape": list(out.shape),
+    }))
+""")
+
+
+@pytest.fixture(scope="module")
+def report():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([sys.executable, "-c", _SCRIPT], env=env,
+                          capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_padded_gather_memory_is_in_degree_sized(report):
+    """Per-device output: dense = n * |x|, padded = in_degree * |x| —
+    an n/in_degree (128/7 ~ 18x) reduction, machine-checked via XLA's
+    memory analysis at a size where dense would OOM the pod."""
+    n, d = 128, report["classes"]
+    shard_bytes = 2048 * 2048 * 4
+    assert report["dense_out"] == n * shard_bytes
+    assert report["pad_out"] == d * shard_bytes
+    # total padded footprint (args+out+temps) stays far under the dense
+    # output alone
+    assert report["pad_out"] + report["pad_temp"] < report["dense_out"] / 4
+
+
+def test_padded_gather_executes_at_128_ranks(report):
+    assert report["exec_correct"]
+    assert report["out_shape"] == [128, 7, 4, 2]
