@@ -35,7 +35,8 @@ def main():
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from bluefog_tpu import models
-    from bluefog_tpu.benchutil import device_fetch, fetch_overhead
+    from bluefog_tpu.benchutil import (chip_peak_flops, compiled_step_flops,
+                                       device_fetch, fetch_overhead, mfu)
     from bluefog_tpu.optim import functional as F
     from bluefog_tpu.topology import ExponentialTwoGraph, uniform_topology_spec
 
@@ -104,11 +105,24 @@ def main():
 
     total_img_per_sec = float(np.median(rates))
     per_chip = total_img_per_sec / n
+
+    # Roofline accounting: per-device FLOPs of the compiled step from
+    # XLA's own cost analysis (includes remat recompute — what the chip
+    # actually executes) over the published bf16 peak.
+    flops_per_step = compiled_step_flops(
+        step_fn, params, aux, opt_state, batch, jnp.int32(0))
+    step_seconds = BATCH_PER_CHIP * n / max(total_img_per_sec, 1e-9) \
+        if total_img_per_sec else 0.0
+    achieved_mfu = mfu(flops_per_step, step_seconds, peak_per_chip=None) \
+        if step_seconds else 0.0
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "img/s/chip",
         "vs_baseline": round(per_chip / REFERENCE_IMG_PER_SEC_PER_CHIP, 3),
+        "mfu": round(achieved_mfu, 4),
+        "flops_per_step_per_device": flops_per_step,
+        "peak_tflops_per_chip": chip_peak_flops() / 1e12,
     }))
 
 

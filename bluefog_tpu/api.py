@@ -90,10 +90,17 @@ def init(topology_fn=None, is_weighted: bool = False, *,
     tl_path = bfconfig.timeline_path()
     if tl_path:
         ctx.timeline = timeline_mod.start_timeline(tl_path, rank=jax.process_index())
+    if jax.process_count() > 1 and bfconfig.stall_warning_time() > 0:
+        # liveness beacons for the watchdog's rank attribution (reference
+        # operations.cc:388-433 names the missing ranks); pointless when
+        # the watchdog — their only consumer — is disabled
+        interval = max(1.0, bfconfig.stall_warning_time() / 4)
+        ctx_mod._heartbeat.start(interval)
 
 
 def shutdown() -> None:
     global _win_manager
+    ctx_mod._heartbeat.stop()
     timeline_mod.stop_timeline()
     _win_manager = None
     ctx_mod.set_context(None)

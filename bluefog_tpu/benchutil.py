@@ -15,7 +15,66 @@ import time
 import jax
 import numpy as np
 
-__all__ = ["device_fetch", "fetch_overhead", "timed"]
+__all__ = ["device_fetch", "fetch_overhead", "timed",
+           "chip_peak_flops", "compiled_step_flops", "mfu"]
+
+# Dense bf16 peak FLOP/s per chip, from published TPU specs.  Keyed by
+# substrings of jax's ``device_kind``; override with BLUEFOG_CHIP_PEAK_TFLOPS
+# when the kind is unlisted (e.g. a new generation).
+_PEAK_BF16_TFLOPS = (
+    ("v6e", 918.0),      # Trillium
+    ("v6", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),  # v5e's device_kind spelling in some releases
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def chip_peak_flops(device=None) -> float:
+    """Peak dense bf16 FLOP/s of one chip, or 0.0 when unknown (CPU test
+    meshes).  Override: BLUEFOG_CHIP_PEAK_TFLOPS=<float>."""
+    import os
+
+    override = os.environ.get("BLUEFOG_CHIP_PEAK_TFLOPS")
+    if override:
+        return float(override) * 1e12
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, tf in _PEAK_BF16_TFLOPS:
+        if key in kind:
+            return tf * 1e12
+    return 0.0
+
+
+def compiled_step_flops(jitted, *args) -> float:
+    """Per-device FLOPs of one execution of ``jitted(*args)`` from XLA's
+    own cost analysis of the optimized module — the hardware-honest count
+    (rematerialized FLOPs included, which is what the chip executes).
+    Returns 0.0 if the backend exposes no cost model."""
+    try:
+        compiled = jitted.lower(*args).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax: one dict/device
+            cost = cost[0]
+        return float(cost.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def mfu(flops_per_step: float, step_seconds: float,
+        peak_per_chip: float = None) -> float:
+    """Model FLOPs utilization: achieved FLOP/s over peak FLOP/s.
+    ``flops_per_step`` is PER DEVICE (as ``compiled_step_flops`` reports);
+    returns 0.0 when the peak is unknown."""
+    if peak_per_chip is None:
+        peak_per_chip = chip_peak_flops()
+    if not peak_per_chip or step_seconds <= 0:
+        return 0.0
+    return flops_per_step / step_seconds / peak_per_chip
 
 
 def device_fetch(a) -> np.ndarray:

@@ -17,6 +17,7 @@ __all__ = [
     "skip_negotiate_default",
     "ops_on_cpu",
     "stall_warning_time",
+    "fusion_threshold",
 ]
 
 
@@ -39,6 +40,14 @@ def timeline_path() -> str:
     """BLUEFOG_TIMELINE: path prefix for per-process Chrome-trace files
     (reference operations.cc:464-473)."""
     return _env("BLUEFOG_TIMELINE", "")
+
+
+def fusion_threshold() -> int:
+    """BLUEFOG_FUSION_THRESHOLD: max bytes of per-rank payload packed into
+    one flat fusion buffer by the eager optimizers' communication
+    (reference operations.cc:42-44 default 8 MB + tensor_queue.h:75-124).
+    0 disables fusion (one collective per parameter leaf)."""
+    return int(_env("BLUEFOG_FUSION_THRESHOLD", str(8 * 1024 * 1024)))
 
 
 def skip_negotiate_default() -> bool:

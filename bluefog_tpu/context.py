@@ -45,12 +45,112 @@ class BluefogError(RuntimeError):
     pass
 
 
+class _Heartbeat:
+    """Per-process liveness beacon over the jax.distributed KV store.
+
+    The reference's stall watchdog names the ranks a stalled tensor is
+    still waiting on (operations.cc:388-433, from the coordinator's
+    message table).  SPMD has no negotiation table, so liveness comes from
+    heartbeats instead: every process periodically bumps a SEQUENCE
+    NUMBER under ``bf_heartbeat_<pid>``; a stalled process scans all
+    keys and names the processes whose sequence has not advanced.
+    Sequence numbers (not wall times) make staleness a single-clock
+    judgment — the observer compares its OWN monotonic clock across two
+    of its own reads, so cross-host clock skew can neither falsely
+    accuse a live rank nor mask a silent one."""
+
+    KEY = "bf_heartbeat_{pid}"
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # pid -> (last seen sequence, observer-monotonic time it changed)
+        self._seen: Dict[int, Tuple[int, float]] = {}
+
+    @staticmethod
+    def _client():
+        try:
+            from jax._src import distributed
+
+            return distributed.global_state.client
+        except Exception:
+            return None
+
+    def start(self, interval: float):
+        client = self._client()
+        if client is None or self._thread is not None:
+            return
+        me = jax.process_index()
+        key = self.KEY.format(pid=me)
+
+        def beat():
+            seq = 1
+            while not self._stop.wait(interval):
+                try:
+                    client.key_value_set(key, str(seq),
+                                         allow_overwrite=True)
+                    seq += 1
+                except Exception:  # coordinator gone: job is ending
+                    return
+
+        client.key_value_set(key, "0", allow_overwrite=True)
+        self._stop.clear()
+        self._thread = threading.Thread(target=beat, daemon=True,
+                                        name="bf-heartbeat")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def observe(self) -> None:
+        """Record one observation of every process's sequence number.
+        Called periodically by the watchdog loop while waits are active,
+        building the history ``stale_processes`` judges against."""
+        client = self._client()
+        if client is None or jax.process_count() <= 1:
+            return
+        import time
+
+        now = time.monotonic()
+        for pid in range(jax.process_count()):
+            try:
+                seq = int(client.key_value_try_get(self.KEY.format(pid=pid)))
+            except Exception:  # never wrote a beat
+                seq = -1
+            prev = self._seen.get(pid)
+            if prev is None or prev[0] != seq:
+                self._seen[pid] = (seq, now)
+
+    def stale_processes(self, threshold: float) -> List[int]:
+        """Processes whose sequence number has not advanced for
+        ``threshold`` seconds of THIS process's monotonic clock (or who
+        never wrote a beat).  Empty when liveness cannot be determined
+        (single process / no KV store)."""
+        client = self._client()
+        if client is None or jax.process_count() <= 1:
+            return []
+        import time
+
+        self.observe()
+        now = time.monotonic()
+        return [pid for pid, (seq, changed) in sorted(self._seen.items())
+                if seq < 0 or now - changed > threshold]
+
+
+_heartbeat = _Heartbeat()
+
+
 class StallWatchdog:
     """Warns when a blocking wait runs longer than
     BLUEFOG_STALL_WARNING_TIME (reference stall watchdog: rank 0 prints
-    tensors waiting >60 s, operations.cc:388-433).  One scanning thread for
-    the whole process; waits register/unregister in a dict, so the per-op
-    cost is a lock + dict write."""
+    tensors waiting >60 s AND which ranks they wait on,
+    operations.cc:388-433 — rank attribution here comes from the
+    heartbeat beacons).  One scanning thread for the whole process; waits
+    register/unregister in a dict, so the per-op cost is a lock + dict
+    write."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -85,18 +185,35 @@ class StallWatchdog:
             now = time.monotonic()
             stalled = []
             with self._lock:
+                has_waits = bool(self._waits)
                 for token, (name, start, warned) in list(self._waits.items()):
                     elapsed = now - start
                     if elapsed > threshold * (warned + 1):
                         stalled.append((name, elapsed))
                         self._waits[token] = (name, start, warned + 1)
+            if has_waits:
+                # accumulate sequence observations while anything is
+                # waiting, so a later stall has history to judge against
+                _heartbeat.observe()
             # log OUTSIDE the lock: a slow log handler must not block the
             # register/unregister fast path of every wait
+            if stalled:
+                # 0.7x margin: the first observation of a frozen rank may
+                # lag its actual freeze by up to one scan interval
+                stale = _heartbeat.stale_processes(threshold * 0.7)
             for name, elapsed in stalled:
-                logger.warning(
-                    "Stall detected: op '%s' has been waiting for %.1f s. "
-                    "One or more processes/devices may be stuck or dead "
-                    "(reference operations.cc:388-433).", name, elapsed)
+                if stale:
+                    logger.warning(
+                        "Stall detected: op '%s' has been waiting for "
+                        "%.1f s on missing process(es) %s — their liveness "
+                        "heartbeat is stale or absent (reference "
+                        "operations.cc:388-433).", name, elapsed, stale)
+                else:
+                    logger.warning(
+                        "Stall detected: op '%s' has been waiting for "
+                        "%.1f s. One or more processes/devices may be "
+                        "stuck or dead (reference operations.cc:388-433).",
+                        name, elapsed)
 
     def watch(self, name: str):
         from contextlib import contextmanager
@@ -221,6 +338,7 @@ class BluefogContext:
         self._handle_lock = threading.Lock()
         self._handle_map: Dict[int, Tuple[str, Any]] = {}
         self._inflight_names: set = set()
+        self._timeline_open: set = set()
         self._next_handle = 0
 
         self.windows: Dict[str, Any] = {}  # name -> Window (windows.py)
@@ -420,10 +538,21 @@ class BluefogContext:
         return fn
 
     def run_op(self, key: Tuple, kernel: Callable, x) -> jax.Array:
+        """Dispatch one eager collective.  With the timeline enabled this
+        records the reference's ENQUEUE_<OP> span around the host-side
+        dispatch (reference torch/mpi_ops.cc:178-488 starts the span at the
+        binding, operations.cc:760 ends it when the background thread picks
+        the entry up; here "enqueue" is trace-lookup + XLA dispatch)."""
         x = self.rank_sharded(x)
-        if self.timeline is not None:
-            self.timeline.activity(str(key[0]))
-        return self._shardmapped(key, kernel)(x)
+        op = str(key[0])
+        tl = self.timeline
+        if tl is None:
+            return self._shardmapped(key, kernel)(x)
+        tl.start_activity(op, f"ENQUEUE_{op.upper()}")
+        try:
+            return self._shardmapped(key, kernel)(x)
+        finally:
+            tl.end_activity(op)
 
     # ------------------------------------------------------------------ #
     # handles (reference torch/handle_manager.{h,cc} + mpi_ops.py:947-1005)
@@ -440,7 +569,17 @@ class BluefogContext:
                 )
             self._inflight_names.add(key)
             self._handle_map[handle] = (key, value)
-            return handle
+        # Per-tensor COMMUNICATE span with the data-plane op nested inside
+        # (reference mpi_controller.cc:333,445 starts COMMUNICATE, the
+        # vendor op name appears as MPI_<OP>; here the data plane is XLA,
+        # so the nested span is XLA_<OP>).  The span runs from dispatch
+        # until device completion is observed at synchronize/wait.
+        tl = self.timeline
+        if tl is not None:
+            tl.start_activity(key, "COMMUNICATE")
+            tl.start_activity(key, f"XLA_{op.upper()}")
+            self._timeline_open.add(key)
+        return handle
 
     def synchronize(self, handle: int):
         with self._handle_lock:
@@ -448,8 +587,18 @@ class BluefogContext:
                 raise BluefogError(f"Unknown handle {handle}")
             key, value = self._handle_map.pop(handle)
             self._inflight_names.discard(key)
-        with _watchdog.watch(key):
-            return jax.block_until_ready(value)
+        try:
+            with _watchdog.watch(key):
+                return jax.block_until_ready(value)
+        finally:
+            # close spans even when the collective fails (a dead peer
+            # raises here) — the trace must stay B/E-balanced precisely
+            # in the failure case where it gets inspected
+            tl = self.timeline
+            if tl is not None and key in self._timeline_open:
+                tl.end_activity(key)  # XLA_<OP>
+                tl.end_activity(key)  # COMMUNICATE
+                self._timeline_open.discard(key)
 
     def poll(self, handle: int) -> bool:
         with self._handle_lock:

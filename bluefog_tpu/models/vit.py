@@ -38,6 +38,16 @@ class ViTConfig:
     attn_impl: str = "xla"  # xla | flash (Pallas)
     attn_block_size: int = 256
     pool: str = "cls"  # cls | gap
+    # Learned register tokens (Darcet et al., "Vision Transformers Need
+    # Registers") appended after the patch+cls sequence; their outputs are
+    # discarded before pooling.  "auto" adds exactly enough to make the
+    # token count 8-aligned — the default 224/16+cls geometry gives t=197
+    # (prime), which Mosaic would otherwise have to tile as a
+    # non-8-aligned Pallas block.  The count depends ONLY on the token
+    # geometry, never on attn_impl, so the parameter tree is identical
+    # across the xla/flash/blockwise implementations (a flash-trained
+    # checkpoint evaluates bit-compatibly on the xla path).
+    n_register_tokens: object = "auto"  # int | "auto"
 
     @property
     def n_patches(self) -> int:
@@ -133,10 +143,23 @@ class ViT(nn.Module):
                          nn.initializers.normal(stddev=0.02),
                          (1, t, cfg.dim), jnp.float32)
         x = x + pos.astype(cfg.dtype)
+        if cfg.n_register_tokens == "auto":
+            n_reg = (-t) % 8
+        else:
+            n_reg = int(cfg.n_register_tokens)
+        if n_reg:
+            reg = self.param("reg_tokens",
+                             nn.initializers.normal(stddev=0.02),
+                             (1, n_reg, cfg.dim), jnp.float32)
+            x = jnp.concatenate(
+                [x, jnp.broadcast_to(reg, (b, n_reg, cfg.dim)).astype(
+                    cfg.dtype)], axis=1)
         for i in range(cfg.depth):
             x = _Block(cfg, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="norm")(x)
+        if n_reg:
+            x = x[:, :t]  # registers are working memory, not outputs
         x = x[:, 0] if cfg.pool == "cls" else jnp.mean(x, axis=1)
         return nn.Dense(cfg.num_classes, dtype=jnp.float32,
                         param_dtype=jnp.float32, name="head")(x)

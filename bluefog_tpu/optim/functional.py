@@ -321,4 +321,9 @@ def build_train_step(
             params, (), opt_state, batch, step)
         return params, opt_state, loss
 
+    # AOT access for benchmarks: lower/compile the real program (e.g. for
+    # XLA cost analysis / MFU accounting) without re-jitting the wrapper.
+    no_aux_step.jitted = jitted
+    no_aux_step.lower = lambda params, opt_state, batch, step: jitted.lower(
+        params, (), opt_state, batch, step)
     return no_aux_step

@@ -44,6 +44,7 @@ import numpy as np
 import optax
 
 from bluefog_tpu import api
+from bluefog_tpu import config as bfconfig
 from bluefog_tpu.context import get_context
 
 __all__ = [
@@ -72,6 +73,78 @@ class CommunicationType(enum.Enum):
 class _OptState(NamedTuple):
     base: Any
     step: jnp.ndarray  # scalar int32
+
+
+class _FusionPlan:
+    """Tensor fusion for the eager path (reference operations.cc:943-1020 +
+    FusionBufferManager tensor_queue.h:75-124): same-dtype parameter leaves
+    are packed, in order, into flat ``[n, K]`` buffers of at most
+    ``threshold`` bytes per rank, so one combine issues O(#buffers)
+    collective programs instead of O(#leaves) — ~160 leaves of ResNet-50
+    become 2-3 dispatches.  Sound for any elementwise-linear collective
+    (allreduce / neighbor_allreduce / hierarchical): the weighted combine
+    distributes over concatenation.
+
+    ``pack`` and ``unpack`` are each ONE jitted program, cached per leaf
+    signature (module-level, bounded by the distinct model shapes in the
+    process).
+    """
+
+    _cache: Dict[Any, "_FusionPlan"] = {}
+
+    def __init__(self, signature, threshold: int):
+        self.signature = signature  # tuple of ((n, ...) shape, dtype str)
+        groups = []  # list of lists of leaf indices
+        cur, cur_bytes = [], 0
+        cur_dtype = None
+        for i, (shape, dtype) in enumerate(signature):
+            per_rank = int(np.prod(shape[1:])) * jnp.dtype(dtype).itemsize
+            if cur and (dtype != cur_dtype
+                        or cur_bytes + per_rank > threshold):
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += per_rank
+            cur_dtype = dtype
+        if cur:
+            groups.append(cur)
+        self.groups = groups
+
+        def pack(leaves):
+            n = leaves[0].shape[0]
+            return tuple(
+                jnp.concatenate(
+                    [jnp.reshape(leaves[i], (n, -1)) for i in g], axis=1)
+                if len(g) > 1 else leaves[g[0]]
+                for g in groups)
+
+        def unpack(buffers):
+            outs = [None] * len(signature)
+            for g, buf in zip(groups, buffers):
+                if len(g) == 1:
+                    outs[g[0]] = buf
+                    continue
+                off = 0
+                for i in g:
+                    shape = signature[i][0]
+                    k = int(np.prod(shape[1:]))
+                    outs[i] = jnp.reshape(buf[:, off:off + k], shape)
+                    off += k
+            return tuple(outs)
+
+        self.pack = jax.jit(pack)
+        self.unpack = jax.jit(unpack)
+
+    @classmethod
+    def for_leaves(cls, leaves, threshold: int) -> "_FusionPlan":
+        signature = tuple(
+            (tuple(l.shape), str(jnp.asarray(l).dtype)) for l in leaves)
+        key = (signature, threshold)
+        plan = cls._cache.get(key)
+        if plan is None:
+            plan = cls(signature, threshold)
+            cls._cache[key] = plan
+        return plan
 
 
 def _tree_names(params) -> Dict[str, Any]:
@@ -106,19 +179,32 @@ class _DistributedOptimizerBase:
 
     # communication helpers ------------------------------------------------
     def _pipelined(self, params, launch: Callable) -> Any:
-        """Dispatch ``launch(leaf) -> handle`` for every leaf, then
-        synchronize once — all collectives are enqueued before the first
-        host wait (the reference gets this overlap from its hooks +
+        """Dispatch ``launch(buffer) -> handle`` for every fusion buffer,
+        then synchronize once — all collectives are enqueued before the
+        first host wait (the reference gets this overlap from its hooks +
         background thread; here JAX async dispatch provides it).
+
+        Leaves are packed into flat fusion buffers first (see
+        ``_FusionPlan``; threshold via BLUEFOG_FUSION_THRESHOLD, 0 to
+        disable), mirroring the reference's response fusion
+        (operations.cc:943-1020) — an eager ResNet-50 combine issues a
+        handful of programs, not one per parameter.
 
         Records a COMMUNICATE timeline span when the timeline is enabled
         (the reference's optimizers register timeline hooks,
         optimizers.py:112-163)."""
         leaves, treedef = jax.tree_util.tree_flatten(params)
+        threshold = bfconfig.fusion_threshold()
         with api.timeline_context(type(self).__name__, "COMMUNICATE"):
-            handles = [launch(leaf) for leaf in leaves]
-            outs = [api.synchronize(h) for h in handles]
-        return jax.tree_util.tree_unflatten(treedef, outs)
+            if threshold and len(leaves) > 1:
+                plan = _FusionPlan.for_leaves(leaves, threshold)
+                buffers = plan.pack(leaves)
+                handles = [launch(b) for b in buffers]
+                outs = plan.unpack([api.synchronize(h) for h in handles])
+            else:
+                handles = [launch(leaf) for leaf in leaves]
+                outs = [api.synchronize(h) for h in handles]
+        return jax.tree_util.tree_unflatten(treedef, list(outs))
 
     def _combine(self, params):
         return self._pipelined(

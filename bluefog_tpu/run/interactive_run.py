@@ -1,16 +1,140 @@
 """``ibfrun`` — interactive (Jupyter) cluster launcher.
 
-Reference parity: bluefog/run/interactive_run.py starts/stops an
-ipyparallel cluster so notebook cells can drive a BlueFog job.  On TPU the
-single-controller JAX model makes most notebook use direct (one process
-sees all chips), so this exists for the multi-process case only and is
-gated on ipyparallel being installed.
+Reference parity: bluefog/run/interactive_run.py starts an ipyparallel
+controller plus engines *launched under mpirun* so every engine is an MPI
+rank with bluefog initialized; notebook cells then drive the job with
+``%%px``.  The TPU translation: engines are spawned directly (no mpirun),
+each with the ``BLUEFOG_TPU_{COORDINATOR,NUM_PROCESSES,PROCESS_ID}``
+environment that ``bluefog_tpu.init()`` turns into a
+``jax.distributed.initialize`` — so ``%%px import bluefog_tpu as bf;
+bf.init()`` forms the same multi-process job a ``bfrun`` launch would.
+
+State (engine pids, coordinator address) is kept in
+``~/.bluefog_tpu/ibfrun_<profile>.json`` (the reference keeps engine pids
+in the ipython profile dir, interactive_run.py:170-195) so ``ibfrun stop``
+can tear the cluster down even from a fresh shell.
+
+Single-process TPU notebooks do not need any of this: one process
+addresses every chip — just ``import bluefog_tpu`` and ``init()``.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
+import signal
+import subprocess
 import sys
+import time
+from typing import Dict, List, Optional
+
+from bluefog_tpu.run.run import PASS_PREFIXES
+
+
+def _state_path(profile: str) -> str:
+    d = os.path.expanduser(os.environ.get("BLUEFOG_TPU_STATE_DIR",
+                                          "~/.bluefog_tpu"))
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"ibfrun_{profile}.json")
+
+
+def engine_env(process_id: int, num_proc: int, coordinator: str,
+               force_cpu_devices: Optional[int] = None,
+               base_env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Environment for engine ``process_id`` — the wiring that makes an
+    ipengine a member of the bluefog_tpu job (the reference gets this from
+    mpirun's rank assignment; here bfrun's env contract is reused,
+    bluefog_tpu/run/run.py _child_env)."""
+    env = {k: v for k, v in (base_env or os.environ).items()
+           if k.startswith(PASS_PREFIXES)}
+    env["BLUEFOG_TPU_COORDINATOR"] = coordinator
+    env["BLUEFOG_TPU_NUM_PROCESSES"] = str(num_proc)
+    env["BLUEFOG_TPU_PROCESS_ID"] = str(process_id)
+    if force_cpu_devices:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{force_cpu_devices}")
+    return env
+
+
+def save_state(profile: str, controller_pid: int, engine_pids: List[int],
+               coordinator: str, num_proc: int) -> str:
+    path = _state_path(profile)
+    with open(path, "w") as f:
+        json.dump({"controller_pid": controller_pid,
+                   "engine_pids": engine_pids,
+                   "coordinator": coordinator,
+                   "num_proc": num_proc}, f)
+    return path
+
+
+def load_state(profile: str) -> Optional[dict]:
+    path = _state_path(profile)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def clear_state(profile: str) -> None:
+    path = _state_path(profile)
+    if os.path.exists(path):
+        os.remove(path)
+
+
+def _kill(pid: int, sig=signal.SIGINT) -> bool:
+    try:
+        os.kill(pid, sig)
+        return True
+    except (OSError, ProcessLookupError):
+        return False
+
+
+def start_cluster(num_proc: int, profile: str, coordinator: str,
+                  force_cpu_devices: Optional[int] = None,
+                  engine_ready_timeout: float = 60.0) -> int:
+    """Start ipcontroller + num_proc wired ipengines.  Returns 0 on
+    success.  Requires ipyparallel."""
+    controller = subprocess.Popen(
+        [sys.executable, "-m", "ipyparallel.controller",
+         f"--profile={profile}"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    # the controller writes its connection files asynchronously; engines
+    # retry on their own, so a short grace period suffices
+    time.sleep(2.0)
+    engines = []
+    for i in range(num_proc):
+        env = engine_env(i, num_proc, coordinator, force_cpu_devices)
+        engines.append(subprocess.Popen(
+            [sys.executable, "-m", "ipyparallel.engine",
+             f"--profile={profile}"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+    path = save_state(profile, controller.pid, [p.pid for p in engines],
+                      coordinator, num_proc)
+    print(f"ibfrun: started controller (pid {controller.pid}) + "
+          f"{num_proc} engines; state in {path}")
+    print("In the notebook:\n"
+          f"  import ipyparallel as ipp; rc = ipp.Client(profile={profile!r})\n"
+          "  %%px\n"
+          "  import bluefog_tpu as bf\n"
+          "  bf.init()")
+    return 0
+
+
+def stop_cluster(profile: str) -> int:
+    state = load_state(profile)
+    if state is None:
+        sys.stderr.write(f"ibfrun: no running cluster for profile "
+                         f"'{profile}'\n")
+        return 1
+    for pid in state["engine_pids"]:
+        _kill(pid)
+    _kill(state["controller_pid"])
+    clear_state(profile)
+    print(f"ibfrun: stopped cluster '{profile}'")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -20,6 +144,11 @@ def main(argv=None) -> int:
     parser.add_argument("action", choices=["start", "stop"])
     parser.add_argument("-np", "--num-proc", type=int, default=1)
     parser.add_argument("--profile", default="bluefog")
+    parser.add_argument("--coordinator", default="127.0.0.1:7675",
+                        help="jax.distributed coordinator address")
+    parser.add_argument("--force-cpu-devices", type=int, default=None,
+                        metavar="K",
+                        help="simulate K CPU devices per engine (testing)")
     args = parser.parse_args(argv)
 
     try:
@@ -31,13 +160,10 @@ def main(argv=None) -> int:
             "addresses every chip — just `import bluefog_tpu` and init().\n")
         return 1
 
-    import subprocess
     if args.action == "start":
-        cmd = ["ipcluster", "start", f"--profile={args.profile}",
-               f"--n={args.num_proc}", "--daemonize"]
-    else:
-        cmd = ["ipcluster", "stop", f"--profile={args.profile}"]
-    return subprocess.call(cmd)
+        return start_cluster(args.num_proc, args.profile, args.coordinator,
+                             args.force_cpu_devices)
+    return stop_cluster(args.profile)
 
 
 if __name__ == "__main__":

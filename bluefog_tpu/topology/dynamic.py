@@ -178,9 +178,9 @@ def GetInnerOuterRingDynamicSendRecvRanks(
         "It should be used under homogeneous environment only."
     )
     assert local_size > 2, (
-        "Do no support the case where nodes_per_machine is equal or less "
-        "than 2. Consider use hierarchical_neighbor_allreduce or "
-        "GetDynamicOnePeerSendRecvRanks."
+        "Unsupported case: nodes_per_machine must exceed 2. Consider "
+        "hierarchical_neighbor_allreduce or "
+        "GetDynamicOnePeerSendRecvRanks instead."
     )
     index = 0
     while True:
@@ -222,9 +222,9 @@ def GetInnerOuterExpo2DynamicSendRecvRanks(
         "It should be used under homogeneous environment only."
     )
     assert local_size > 2, (
-        "Do no support the case where nodes_per_machine is equal or less "
-        "than 2. Consider use hierarchical_neighbor_allreduce or "
-        "GetDynamicOnePeerSendRecvRanks."
+        "Unsupported case: nodes_per_machine must exceed 2. Consider "
+        "hierarchical_neighbor_allreduce or "
+        "GetDynamicOnePeerSendRecvRanks instead."
     )
     index = 0
     while True:

@@ -234,30 +234,39 @@ class WindowManager:
             self_weight = 1.0
         from bluefog_tpu.context import WeightArg
 
-        sw = np.asarray(
-            WeightArg.per_rank(self_weight, ctx.size(), "self"), dtype=np.float64
-        )
+        sw = jnp.asarray(
+            np.asarray(WeightArg.per_rank(self_weight, ctx.size(), "self"),
+                       dtype=np.float32))
         spec = self._resolve_dst(win, dst_weights)
         associated_p = ctx.win_ops_with_associated_p
 
-        key = ("win_put", name, spec.digest(), bool(accumulate), associated_p,
-               tuple(sw.tolist()), x.shape, str(x.dtype))
+        # The compiled program is keyed on the edge STRUCTURE only; the
+        # per-edge and self weights enter as traced operands, so a dynamic
+        # gossip schedule that varies weights every step reuses ONE
+        # compilation (round-1 hazard: weights in the cache key retraced
+        # per step with unbounded cache growth).
+        structure = _edge_structure(spec)
+        wvecs = _class_recv_weights(spec)
+        key = ("win_put", name, spec.edges, bool(accumulate), associated_p,
+               x.shape, str(x.dtype))
         fn = ctx._op_cache.get(key)
         if fn is None:
             fn = jax.jit(
                 jax.shard_map(
-                    lambda xx, mb, vv, pp, pmb: _put_kernel(
-                        xx, mb, vv, pp, pmb, spec, sw, accumulate, associated_p
+                    lambda xx, mb, vv, pp, pmb, wv, sv: _put_kernel(
+                        xx, mb, vv, pp, pmb, wv, sv, structure, accumulate,
+                        associated_p
                     ),
                     mesh=ctx.mesh,
-                    in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+                    in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                              P(), P()),
                     out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
                     check_vma=False,
                 )
             )
             ctx._op_cache[key] = fn
         new_value, win.mailbox, win.versions, win.p, win.p_mailbox = fn(
-            x, win.mailbox, win.versions, win.p, win.p_mailbox
+            x, win.mailbox, win.versions, win.p, win.p_mailbox, wvecs, sw
         )
         win.value = new_value
         return self._register(name, (new_value, win.mailbox))
@@ -276,24 +285,27 @@ class WindowManager:
         spec = self._resolve_src(win, src_weights)
         associated_p = ctx.win_ops_with_associated_p
 
-        key = ("win_get", name, spec.digest(), associated_p,
+        structure = _edge_structure(spec)
+        wvecs = _class_recv_weights(spec)
+        key = ("win_get", name, spec.edges, associated_p,
                win.value.shape, str(win.value.dtype))
         fn = ctx._op_cache.get(key)
         if fn is None:
             fn = jax.jit(
                 jax.shard_map(
-                    lambda xx, mb, vv, pp, pmb: _get_kernel(
-                        xx, mb, vv, pp, pmb, spec, associated_p
+                    lambda xx, mb, vv, pp, pmb, wv: _get_kernel(
+                        xx, mb, vv, pp, pmb, wv, structure, associated_p
                     ),
                     mesh=ctx.mesh,
-                    in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+                    in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                              P()),
                     out_specs=(P(AXIS), P(AXIS), P(AXIS)),
                     check_vma=False,
                 )
             )
             ctx._op_cache[key] = fn
         win.mailbox, win.versions, win.p_mailbox = fn(
-            win.value, win.mailbox, win.versions, win.p, win.p_mailbox
+            win.value, win.mailbox, win.versions, win.p, win.p_mailbox, wvecs
         )
         return self._register(name, (win.mailbox,))
 
@@ -366,24 +378,30 @@ class WindowManager:
         spec = DynamicTopology.from_edges(n, edge_weights, self_w)
         associated_p = ctx.win_ops_with_associated_p
 
-        key = ("win_update", name, spec.digest(), bool(reset), associated_p,
+        structure = _edge_structure(spec)
+        wvecs = _class_recv_weights(spec)
+        sw = jnp.asarray(np.asarray(spec.self_weight_values, np.float32))
+        key = ("win_update", name, spec.edges, bool(reset), associated_p,
                win.value.shape, str(win.value.dtype))
         fn = ctx._op_cache.get(key)
         if fn is None:
             fn = jax.jit(
                 jax.shard_map(
-                    lambda xx, mb, vv, pp, pmb: _update_kernel(
-                        xx, mb, vv, pp, pmb, spec, reset, associated_p
+                    lambda xx, mb, vv, pp, pmb, wm, sv: _update_kernel(
+                        xx, mb, vv, pp, pmb, wm, sv, structure, reset,
+                        associated_p
                     ),
                     mesh=ctx.mesh,
-                    in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+                    in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                              P(), P()),
                     out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
                     check_vma=False,
                 )
             )
             ctx._op_cache[key] = fn
         new_value, mailbox, versions, p, p_mailbox = fn(
-            win.value, win.mailbox, win.versions, win.p, win.p_mailbox
+            win.value, win.mailbox, win.versions, win.p, win.p_mailbox,
+            wvecs, sw
         )
         win.mailbox, win.versions, win.p_mailbox = mailbox, versions, p_mailbox
         win.p = p
@@ -412,28 +430,50 @@ class WindowManager:
 # ------------------------------------------------------------------ #
 # shard-level kernels (shapes: x [1,*s]; mailbox [1,n,*s]; ver [1,n];
 # p [1]; p_mailbox [1,n])
+#
+# Weights are TRACED operands (a [n_classes, n] per-shift-class recv
+# vector stack + [n] self vector — O(n * classes), never a dense [n, n]
+# matrix); only the edge structure (which edges exist) is baked into the
+# compiled program — so schedules that vary weights per step reuse one
+# compilation.
 # ------------------------------------------------------------------ #
-def _send_weight_vector(cls, size: int, idx):
-    """Sender-side view of a shift class's weights: what rank idx applies
-    when sending through this class."""
-    recv = jnp.asarray(cls.recv_weights, dtype=jnp.float32)
-    return recv[(idx + cls.shift) % size]
+def _edge_structure(spec: DynamicTopology) -> DynamicTopology:
+    """The spec with all edge weights replaced by 1.0 — the compile-time
+    skeleton.  A DECLARED edge transfers even when its weight is 0.0
+    (matching the reference, which sends the scaled-by-zero payload,
+    mpi_controller.cc:594-600, rather than skipping the send)."""
+    return DynamicTopology.from_edges(
+        spec.size, {e: 1.0 for e in spec.edges})
 
 
-def _put_kernel(x, mailbox, versions, p, p_mailbox, spec, self_weights,
-                accumulate, associated_p):
-    n = spec.size
+def _class_recv_weights(spec: DynamicTopology) -> jnp.ndarray:
+    """[n_classes, n] f32: row c, entry d = the weight rank d applies to
+    what it receives through shift class c (0 where no edge).  Class
+    order matches ``_edge_structure(spec).shift_classes`` (both decompose
+    the same edge set, sorted by shift)."""
+    rows = [cls.recv_weights for cls in spec.shift_classes]
+    if not rows:
+        return jnp.zeros((0, spec.size), jnp.float32)
+    return jnp.asarray(np.asarray(rows, np.float32))
+
+
+def _put_kernel(x, mailbox, versions, p, p_mailbox, wvecs, self_weights,
+                structure, accumulate, associated_p):
+    n = structure.size
     idx = lax.axis_index(AXIS)
     xs = x[0]
     mb = mailbox[0]
     ver = versions[0]
     pv = p[0]
     pmb = p_mailbox[0]
-    for cls in spec.shift_classes:
-        w_send = _send_weight_vector(cls, n, idx).astype(xs.dtype)
-        sent = lax.ppermute(xs * w_send, AXIS, cls.perm)
-        recv_w = jnp.asarray(cls.recv_weights, dtype=jnp.float32)[idx]
-        has = recv_w != 0.0
+    for c, cls in enumerate(structure.shift_classes):
+        # sender-side scale: the receiver's weight for this class, read
+        # at my destination (me + shift)
+        w_send = wvecs[c, (idx + cls.shift) % n].astype(jnp.float32)
+        sent = lax.ppermute(
+            (xs.astype(jnp.float32) * w_send).astype(xs.dtype),
+            AXIS, cls.perm)
+        has = jnp.asarray(cls.recv_weights, jnp.float32)[idx] != 0.0
         src = (idx - cls.shift) % n
         slot = lax.dynamic_index_in_dim(mb, src, 0, keepdims=False)
         new_slot = jnp.where(has, slot + sent if accumulate else sent, slot)
@@ -442,30 +482,32 @@ def _put_kernel(x, mailbox, versions, p, p_mailbox, spec, self_weights,
             ver, jnp.where(has, ver[src] + 1, ver[src]), src, 0
         )
         if associated_p:
-            p_sent = lax.ppermute(pv * _send_weight_vector(cls, n, idx).astype(pv.dtype),
+            p_sent = lax.ppermute(pv * w_send.astype(pv.dtype),
                                   AXIS, cls.perm)
             p_slot = pmb[src]
             new_p = jnp.where(has, p_slot + p_sent if accumulate else p_sent, p_slot)
             pmb = lax.dynamic_update_index_in_dim(pmb, new_p, src, 0)
-    sw = jnp.asarray(self_weights, dtype=jnp.float32)[idx]
+    sw = self_weights.astype(jnp.float32)[idx]
     new_x = (xs.astype(jnp.float32) * sw).astype(xs.dtype)
     new_p_val = pv * sw.astype(pv.dtype) if associated_p else pv
     return (new_x[None], mb[None], ver[None], new_p_val[None], pmb[None])
 
 
-def _get_kernel(x, mailbox, versions, p, p_mailbox, spec, associated_p):
-    n = spec.size
+def _get_kernel(x, mailbox, versions, p, p_mailbox, wvecs, structure,
+                associated_p):
+    n = structure.size
     idx = lax.axis_index(AXIS)
     xs = x[0]
     mb = mailbox[0]
     ver = versions[0]
     pv = p[0]
     pmb = p_mailbox[0]
-    for cls in spec.shift_classes:
+    for c, cls in enumerate(structure.shift_classes):
         fetched = lax.ppermute(xs, AXIS, cls.perm)
-        recv_w = jnp.asarray(cls.recv_weights, dtype=jnp.float32)[idx]
-        has = recv_w != 0.0
         src = (idx - cls.shift) % n
+        # receiver-side scale: my weight for this class
+        recv_w = wvecs[c, idx].astype(jnp.float32)
+        has = jnp.asarray(cls.recv_weights, jnp.float32)[idx] != 0.0
         slot = lax.dynamic_index_in_dim(mb, src, 0, keepdims=False)
         scaled = (fetched.astype(jnp.float32) * recv_w).astype(xs.dtype)
         mb = lax.dynamic_update_index_in_dim(
@@ -484,9 +526,9 @@ def _get_kernel(x, mailbox, versions, p, p_mailbox, spec, associated_p):
     return (mb[None], ver[None], pmb[None])
 
 
-def _update_kernel(x, mailbox, versions, p, p_mailbox, spec, reset,
-                   associated_p):
-    n = spec.size
+def _update_kernel(x, mailbox, versions, p, p_mailbox, wvecs, self_weights,
+                   structure, reset, associated_p):
+    n = structure.size
     idx = lax.axis_index(AXIS)
     xs = x[0]
     mb = mailbox[0]
@@ -494,25 +536,24 @@ def _update_kernel(x, mailbox, versions, p, p_mailbox, spec, reset,
     pv = p[0]
     pmb = p_mailbox[0]
 
-    self_w = jnp.asarray(spec.self_weight_values, dtype=jnp.float32)[idx]
-    # weight matrix column for me: w[src] applied to mailbox slot src
-    wmat = np.zeros((n, n), dtype=np.float32)
-    for (s, d), w in zip(spec.edges, spec.edge_weight_values):
-        wmat[d, s] = w
-    w_col = jnp.asarray(wmat)[idx]  # [n]
-
+    self_w = self_weights.astype(jnp.float32)[idx]
     acc = xs.astype(jnp.float32) * self_w
-    contrib = jnp.tensordot(w_col, mb.astype(jnp.float32), axes=1)
-    new_x = (acc + contrib).astype(xs.dtype)
-
-    new_p = pv
-    if associated_p:
-        new_p = pv * self_w.astype(pv.dtype) + jnp.dot(
-            w_col.astype(pv.dtype), pmb
-        )
+    new_p = pv * self_w.astype(pv.dtype) if associated_p else pv
+    # structural inclusion mask per slot (which slots this update
+    # consumes) — a declared 0.0-weight edge still counts as read
+    included = jnp.zeros((n,), bool)
+    for c, cls in enumerate(structure.shift_classes):
+        src = (idx - cls.shift) % n
+        has = jnp.asarray(cls.recv_weights, jnp.float32)[idx] != 0.0
+        w = jnp.where(has, wvecs[c, idx], 0.0)
+        slot = lax.dynamic_index_in_dim(mb, src, 0, keepdims=False)
+        acc = acc + slot.astype(jnp.float32) * w
+        if associated_p:
+            new_p = new_p + pmb[src] * w.astype(pv.dtype)
+        included = included.at[src].set(included[src] | has)
+    new_x = acc.astype(xs.dtype)
 
     if reset:
-        included = (w_col != 0.0)
         shape_ones = (n,) + (1,) * (mb.ndim - 1)
         keep = (~included).astype(mb.dtype).reshape(shape_ones)
         mb = mb * keep
@@ -522,7 +563,6 @@ def _update_kernel(x, mailbox, versions, p, p_mailbox, spec, reset,
     else:
         # Reading via update clears versions for the slots it consumed
         # (reference mpi_controller.cc:1284-1392 version windows).
-        included = (w_col != 0.0)
         ver = jnp.where(included, 0, ver)
 
     return (new_x[None], mb[None], ver[None], new_p[None], pmb[None])

@@ -21,7 +21,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bluefog_tpu import models
-from bluefog_tpu.benchutil import device_fetch, fetch_overhead
+from bluefog_tpu.benchutil import (chip_peak_flops, compiled_step_flops,
+                                   device_fetch, fetch_overhead, mfu)
 from bluefog_tpu.optim import functional as F
 from bluefog_tpu.topology import (
     ExponentialTwoGraph,
@@ -42,6 +43,9 @@ parser.add_argument("--sp", type=int, default=1,
 parser.add_argument("--attn-impl", default="xla", choices=["xla", "flash"])
 parser.add_argument("--scan-layers", action="store_true",
                     help="nn.scan the decoder stack (O(1) compile in depth)")
+parser.add_argument("--no-remat", action="store_true",
+                    help="disable rematerialization (when HBM allows, "
+                    "saves the recompute FLOPs)")
 parser.add_argument("--remat-policy", default="none",
                     choices=["none", "dots", "everything"])
 parser.add_argument("--num-warmup", type=int, default=3)
@@ -50,7 +54,7 @@ args = parser.parse_args()
 
 
 def make_config():
-    base = dict(remat=True, scan_layers=args.scan_layers,
+    base = dict(remat=not args.no_remat, scan_layers=args.scan_layers,
                 remat_policy=args.remat_policy)
     if args.sp > 1:
         base.update(attn_mode="ring", sp_axis="sp",
@@ -147,13 +151,45 @@ def main():
     final_loss = float(device_fetch(loss).mean())
     dt = max(time.perf_counter() - t0 - rtt, 1e-9)
     tokens = n_dp * args.batch_size * args.seq_len * args.num_steps
-    print(json.dumps({
+    tokens_per_sec = tokens / dt
+
+    # Roofline accounting:
+    #  * mfu     — model-FLOPs utilization from the standard analytic count
+    #              (6*N per token for the dense stack + 6*L*T*d for causal
+    #              attention, fwd+bwd; PaLM-appendix style).  The primary
+    #              number: independent of remat/compiler choices.
+    #  * mfu_hw  — XLA cost-analysis FLOPs of the compiled step (counts
+    #              remat recompute).  CAVEAT: the HLO cost model counts a
+    #              scanned loop body ONCE, so with --scan-layers it
+    #              understates by ~n_layers; reported only when not
+    #              scanning.
+    step_seconds = dt / args.num_steps
+    peak = chip_peak_flops()
+    step_tokens = n_dp * args.batch_size * args.seq_len
+    # 6*N per token over MATMUL params (the input embedding table is a
+    # gather, not a matmul — excluded; the output head is a real matmul —
+    # included in n_params) + causal attention 6*L*T*d.
+    matmul_params = n_params - cfg.vocab_size * cfg.dim
+    model_flops_per_step = (6.0 * matmul_params * step_tokens
+                            + 6.0 * cfg.n_layers * args.seq_len * cfg.dim
+                            * step_tokens)
+    result = {
         "model": args.model, "params": n_params,
         "optimizer": args.dist_optimizer, "mesh": f"{n_dp}dp x {n_sp}sp",
         "attn": cfg.attn_mode + "/" + cfg.attn_impl,
-        "tokens_per_sec": round(tokens / dt, 1),
+        "remat": cfg.remat, "scan_layers": cfg.scan_layers,
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "mfu": round(model_flops_per_step / n_total / step_seconds / peak, 4)
+        if peak else 0.0,
+        "peak_tflops_per_chip": peak / 1e12,
         "loss": round(final_loss, 4), "chips": n_total,
-    }))
+    }
+    if not cfg.scan_layers:
+        hw_flops = compiled_step_flops(
+            step_fn, params, opt_state, batch, jnp.int32(0))
+        result["mfu_hw"] = round(
+            mfu(hw_flops, step_seconds, peak_per_chip=peak), 4)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -170,3 +170,36 @@ def test_two_process_hierarchical_machine_ops(tmp_path):
                  sys.executable, str(script))
     assert out.returncode == 0, out.stdout + out.stderr
     assert out.stdout.count("hier OK") == 2, out.stdout
+
+
+def test_ibfrun_engine_wiring(tmp_path, monkeypatch):
+    """ibfrun's engines receive the same BLUEFOG_TPU_* contract as bfrun
+    children (the wiring that makes `%%px bf.init()` form the job), and
+    cluster state round-trips through the pid file."""
+    from bluefog_tpu.run import interactive_run as ir
+
+    monkeypatch.setenv("BLUEFOG_TPU_STATE_DIR", str(tmp_path))
+    env = ir.engine_env(2, 4, "127.0.0.1:7777", force_cpu_devices=3,
+                        base_env={"PATH": "/bin", "SECRET": "no",
+                                  "JAX_FOO": "yes"})
+    assert env["BLUEFOG_TPU_PROCESS_ID"] == "2"
+    assert env["BLUEFOG_TPU_NUM_PROCESSES"] == "4"
+    assert env["BLUEFOG_TPU_COORDINATOR"] == "127.0.0.1:7777"
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=3" in env["XLA_FLAGS"]
+    assert "SECRET" not in env          # whitelist passthrough only
+    assert env["JAX_FOO"] == "yes"
+
+    path = ir.save_state("t", 111, [222, 333], "127.0.0.1:7777", 2)
+    assert ir.load_state("t") == {
+        "controller_pid": 111, "engine_pids": [222, 333],
+        "coordinator": "127.0.0.1:7777", "num_proc": 2}
+    ir.clear_state("t")
+    assert ir.load_state("t") is None
+    assert not os.path.exists(path)
+
+
+def test_ibfrun_stop_without_cluster(monkeypatch, tmp_path):
+    monkeypatch.setenv("BLUEFOG_TPU_STATE_DIR", str(tmp_path))
+    from bluefog_tpu.run import interactive_run as ir
+    assert ir.stop_cluster("nope") == 1

@@ -284,3 +284,14 @@ def test_loader_state_dict_roundtrips_after_restore():
     loader.load_state_dict({"epoch": 1, "batch": 3})
     assert loader.state_dict() == {"epoch": 1, "batch": 3}
     loader.close()
+
+
+def test_rank_major_rejects_nonzero_rank():
+    """rank_major loading serves every rank from one loader; a nonzero
+    rank would silently duplicate shards (moved here from the deleted
+    fused-combine test file, where it was misfiled)."""
+    from bluefog_tpu.data import DataLoader
+
+    x = np.zeros((16, 2), np.float32)
+    with pytest.raises(ValueError, match="rank_major"):
+        DataLoader([x], batch_size=8, world=4, rank=1, rank_major=True)

@@ -102,10 +102,14 @@ def test_vit_blockwise_matches_full():
 
 
 def test_vit_flash_matches_full():
-    """Pallas flash kernel (interpret mode on CPU) == full attention."""
-    cfg_full = models.ViTConfig.tiny(dtype=jnp.float32)
+    """Pallas flash kernel (interpret mode on CPU) == full attention.
+    Both configs carry the same 7 register tokens (the flash config's
+    "auto" alignment: 16 patches + cls = 17 -> padded to 24), so the
+    parameter trees are identical."""
+    cfg_full = models.ViTConfig.tiny(dtype=jnp.float32,
+                                     n_register_tokens=7)
     cfg_flash = models.ViTConfig.tiny(dtype=jnp.float32, attn_impl="flash",
-                                      attn_block_size=17)  # 16 patches + cls
+                                      attn_block_size=24)
     x = jax.random.normal(jax.random.PRNGKey(3), (2, 32, 32, 3))
     m = models.ViT(cfg_full)
     params = m.init(jax.random.PRNGKey(0), x)
@@ -113,6 +117,25 @@ def test_vit_flash_matches_full():
     out = models.ViT(cfg_flash).apply(params, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_vit_flash_auto_alignment():
+    """attn_impl='flash' auto-pads the token count to a multiple of 8
+    with register tokens (ADVICE round 1: t=197 prime made Mosaic tile a
+    non-8-aligned block); registers exist, tokens align, grads flow."""
+    cfg = models.ViTConfig.tiny(dtype=jnp.float32, attn_impl="flash",
+                                attn_block_size=24)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    m = models.ViT(cfg)
+    params = m.init(jax.random.PRNGKey(0), x)
+    assert params["params"]["reg_tokens"].shape == (1, 7, cfg.dim)
+
+    def loss(p):
+        return jnp.mean(m.apply(p, x) ** 2)
+
+    g = jax.grad(loss)(params)
+    leaves = jax.tree.leaves(g)
+    assert all(bool(jnp.all(jnp.isfinite(l))) for l in leaves)
 
 
 def test_vit_trains(bf_ctx):

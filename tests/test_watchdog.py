@@ -54,3 +54,63 @@ def test_watchdog_disabled(monkeypatch, capture, watchdog):
     with watchdog.watch("op"):
         time.sleep(0.1)
     assert not any("Stall detected" in m for m in capture.messages)
+
+
+def test_stalled_collective_names_the_stuck_rank(tmp_path):
+    """Reference operations.cc:388-433 parity: one process goes silent
+    mid-job (alive but stuck — it stops heartbeating and never joins the
+    next collective); the survivor's stalled collective names it via the
+    heartbeat beacons (2 real processes over bfrun).
+
+    A process that DIES outright is already failure-detected by the
+    runtime itself: the collective errors with 'Connection closed by
+    peer' immediately — the stall path is specifically for the silent
+    kind of failure, which is what heartbeats attribute."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "stuck.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, threading, time
+        import numpy as np
+        import jax
+        import bluefog_tpu as bf
+        from bluefog_tpu import context as ctx_mod
+
+        bf.init()
+        me = jax.process_index()
+        x = bf.from_rank_values(lambda r: np.full((4,), float(r)))
+        np.asarray(bf.to_rank_values(bf.neighbor_allreduce(x)))  # warm
+
+        threading.Timer(12.0, lambda: os._exit(0)).start()
+        if me == 1:
+            ctx_mod._heartbeat.stop()  # go silent: no beats, no joins
+            time.sleep(300)
+
+        # rank 0: the next collective cannot complete without rank 1;
+        # the watchdog must name process 1 (the timer ends the process
+        # after the log window — the collective blocks indefinitely).
+        y = bf.neighbor_allreduce(x, name="orphaned")
+        np.asarray(bf.to_rank_values(y))
+    """))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("XLA_", "JAX_"))}
+    env["PYTHONPATH"] = repo
+    env["BLUEFOG_STALL_WARNING_TIME"] = "3"
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run", "-np", "2",
+         "--force-cpu-devices", "4", "--coordinator", f"127.0.0.1:{port}",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240, env=env, cwd=repo)
+    text = out.stdout + out.stderr
+    assert "Stall detected" in text, text
+    assert "orphaned" in text, text
+    assert "process(es) [1]" in text, text

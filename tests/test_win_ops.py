@@ -266,3 +266,52 @@ def test_push_sum_converges_to_average(bf_ctx):
                                    rtol=1e-6)
     finally:
         bf.turn_off_win_ops_with_associated_p()
+
+
+def test_varying_gossip_weights_do_not_recompile(bf_ctx):
+    """Round-1 hazard regression (windows.py): per-step gossip weights used
+    to be baked into the compile-cache key, so any dynamic schedule
+    retraced every step with unbounded cache growth.  Weights are traced
+    operands now: N steps with N different weight sets -> ONE cached
+    program per op kind."""
+    from bluefog_tpu.context import get_context
+
+    bf.set_topology(ExponentialTwoGraph(SIZE))
+    x = bf.from_rank_values(lambda r: np.full((3,), float(r)))
+    bf.win_create(x, "w_retrace")
+    graph = bf.load_topology()
+    out_nbrs = {r: sorted(d for d in graph.successors(r) if d != r)
+                for r in range(SIZE)}
+    in_nbrs = {r: sorted(s for s in graph.predecessors(r) if s != r)
+               for r in range(SIZE)}
+    ctx = get_context()
+    cache_sizes = []
+    for step in range(6):
+        scale = 1.0 / (2.0 + step)  # different weights every step
+        dst_w = [{d: scale for d in out_nbrs[r]} for r in range(SIZE)]
+        self_w = [1.0 - scale * len(out_nbrs[r]) for r in range(SIZE)]
+        bf.win_put(x, "w_retrace", self_weight=self_w, dst_weights=dst_w)
+        nbr_w = [{s: scale for s in in_nbrs[r]} for r in range(SIZE)]
+        x = bf.win_update("w_retrace", self_weight=self_w,
+                          neighbor_weights=nbr_w)
+        cache_sizes.append(len(ctx._op_cache))
+    # cache stabilizes after the first step: no per-step growth
+    assert cache_sizes[-1] == cache_sizes[0], cache_sizes
+    bf.win_free("w_retrace")
+
+
+def test_put_weight_variation_changes_values_not_programs(bf_ctx):
+    """Varying weights through the one cached program still produces the
+    right numbers (weights really are traced operands, not constants)."""
+    bf.set_topology(RingGraph(SIZE))
+    x = bf.from_rank_values(lambda r: np.full((2,), float(r)))
+    bf.win_create(x, "w_wval")
+    for w in (0.5, 0.25):
+        bf.win_put(x, "w_wval", self_weight=1.0,
+                   dst_weights=[{(r + 1) % SIZE: w} for r in range(SIZE)])
+        from bluefog_tpu import api as bf_api
+        mb = np.asarray(bf_api._wm().window("w_wval").mailbox)
+        for r in range(SIZE):
+            src = (r - 1) % SIZE
+            np.testing.assert_allclose(mb[r, src], w * src, rtol=1e-6)
+    bf.win_free("w_wval")
