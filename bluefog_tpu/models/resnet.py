@@ -21,8 +21,41 @@ from typing import Any, Callable, Sequence, Tuple
 
 import jax.numpy as jnp
 from flax import linen as nn
+from jax import lax
 
 ModuleDef = Any
+
+
+class _SpaceToDepthInit(nn.Module):
+    """The stem 7x7/s2 conv, computed space-to-depth (MLPerf ResNet
+    trick): 3 input channels use 3/128 of the MXU's reduction depth, so
+    the 224^2x3 conv is re-indexed as an equivalent 4x4/s1 conv over the
+    112^2x12 2x2-space-to-depth layout — identical numerics (pure weight
+    re-indexing; the parameter stays [7, 7, 3, F] so checkpoints are
+    interchangeable with the plain nn.Conv stem), ~4x better MXU
+    utilization on the stem."""
+
+    features: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        f = self.features
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (7, 7, c, f), jnp.float32)
+        # x[2P+a, 2Q+b, c] -> X[P, Q, (a, b, c)]
+        xs = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+        # w4[m, n, (a, b, c), o] = w7[2m + a - 1, 2n + b - 1, c, o]
+        # (out-of-range rows are the zero padding)
+        w8 = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        w4 = w8.reshape(4, 2, 4, 2, c, f).transpose(0, 2, 1, 3, 4, 5)
+        w4 = w4.reshape(4, 4, 4 * c, f)
+        return lax.conv_general_dilated(
+            xs.astype(self.dtype), w4.astype(self.dtype),
+            window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 class BasicBlock(nn.Module):
@@ -77,6 +110,10 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: jnp.dtype = jnp.bfloat16
+    # space-to-depth stem: numerics-identical, checkpoint-compatible,
+    # measurably faster on the MXU (see _SpaceToDepthInit); disable only
+    # for odd input sizes (needs H and W divisible by 2)
+    space_to_depth: bool = True
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -86,8 +123,12 @@ class ResNet(nn.Module):
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        param_dtype=jnp.float32)
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                 name="conv_init")(x)
+        if self.space_to_depth and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+            x = _SpaceToDepthInit(self.num_filters, self.dtype,
+                                  name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

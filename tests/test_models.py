@@ -243,3 +243,23 @@ def test_llama3_8b_flagship_traces():
     assert 7.9e9 < n_params < 8.2e9, n_params
     out_shape = jax.eval_shape(model.apply, var_shapes, tokens)
     assert tuple(out_shape.shape) == (1, 2048, cfg.vocab_size)
+
+
+def test_resnet_space_to_depth_stem_matches_plain_conv():
+    """Pins the space-to-depth re-indexing invariant: the 4x4/s1 conv over
+    the 2x2-space-to-depth layout equals the plain 7x7/s2 conv with the
+    SAME [7,7,3,F] kernel (numerics-identical, checkpoint-compatible) —
+    a wrong pad side or transpose axis would silently corrupt every
+    forward pass and cross-stem checkpoint load."""
+    m_s2d = models.ResNet18(num_classes=10, dtype=jnp.float32,
+                            space_to_depth=True)
+    m_ref = models.ResNet18(num_classes=10, dtype=jnp.float32,
+                            space_to_depth=False)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 64, 3))
+    v = m_s2d.init(jax.random.PRNGKey(1), x)
+    # identical param trees -> the same variables drive both stems
+    assert v["params"]["conv_init"]["kernel"].shape == (7, 7, 3, 64)
+    a = m_s2d.apply(v, x)
+    b = m_ref.apply(v, x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-5)
