@@ -16,6 +16,7 @@ sequence shards.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
@@ -47,6 +48,17 @@ class LlamaConfig:
     #                         VJP re-runs the Pallas bwd per ring step)
     attn_block_size: int = 512  # for blockwise mode
     sp_axis: Optional[str] = None  # mesh axis for ring mode
+    # Tensor (Megatron-style) parallelism: heads + FFN hidden sharded over
+    # ``tp_axis`` (``tp_size`` shards, static).  Column-parallel kernels
+    # (wq/wk/wv/w1/w3) shard their output dim, row-parallel ones (wo/w2)
+    # their input dim with one psum each per block; activations stay
+    # replicated over tp.  The param TREE is identical to tp_size=1 (the
+    # global kernels keep full logical shapes — sharding happens in the
+    # PartitionSpecs, see ``llama_param_specs``), so checkpoints move
+    # freely between TP layouts.  A capability beyond the reference
+    # (SURVEY.md §2.3: TP absent there).
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
     remat: bool = False
     # Compile the decoder stack as ONE nn.scan'd block instead of L unrolled
     # copies: params gain a leading [n_layers] axis, trace/compile time goes
@@ -68,6 +80,16 @@ class LlamaConfig:
                 f"remat_policy {self.remat_policy!r} not in {valid}")
         if self.remat_policy != "none" and not self.remat:
             raise ValueError("remat_policy requires remat=True")
+        if self.tp_size > 1:
+            if self.tp_axis is None:
+                raise ValueError("tp_size > 1 requires tp_axis")
+            for name, val in (("n_heads", self.n_heads),
+                              ("n_kv_heads", self.n_kv_heads),
+                              ("ffn_dim", self.ffn_dim)):
+                if val % self.tp_size:
+                    raise ValueError(
+                        f"{name} ({val}) must divide by tp_size "
+                        f"({self.tp_size})")
 
     @property
     def head_dim(self) -> int:
@@ -121,6 +143,50 @@ def rotary_embed(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+# --------------------------------------------------------------------- #
+# Megatron's conjugate communication operators.  Under shard_map every tp
+# shard computes an IDENTICAL copy of the loss and differentiates it with
+# seed 1, so the raw lax.psum is wrong in reverse (its transpose is
+# another psum: sharded-kernel cotangents get multiplied by tp_size and
+# the activation cotangent entering a parallel region is left partial).
+# The fix is the f/g pair from the Megatron-LM paper:
+#   f: identity forward, psum backward  (enter a parallel region)
+#   g: psum forward, identity backward  (leave a parallel region)
+# With them, TP gradients equal the unsharded model's exactly
+# (tests/test_tp.py::test_tp_gradients_match_single_shard).
+# --------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_region_in(x, axis_name):
+    return x
+
+
+def _tp_region_in_fwd(x, axis_name):
+    return x, None
+
+
+def _tp_region_in_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+_tp_region_in.defvjp(_tp_region_in_fwd, _tp_region_in_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_region_out(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def _tp_region_out_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _tp_region_out_bwd(axis_name, _, g):
+    return (g,)
+
+
+_tp_region_out.defvjp(_tp_region_out_fwd, _tp_region_out_bwd)
+
+
 class Attention(nn.Module):
     cfg: LlamaConfig
 
@@ -132,9 +198,17 @@ class Attention(nn.Module):
         dense = lambda feats, name: nn.Dense(
             feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
             name=name)
-        q = dense(cfg.n_heads * hd, "wq")(x).reshape(b, t, cfg.n_heads, hd)
-        k = dense(cfg.n_kv_heads * hd, "wk")(x).reshape(b, t, cfg.n_kv_heads, hd)
-        v = dense(cfg.n_kv_heads * hd, "wv")(x).reshape(b, t, cfg.n_kv_heads, hd)
+        # under TP this module runs per-shard: local head counts; wo's
+        # partial output is psum'd below (Megatron column->row pattern,
+        # entered through the 'f' operator so the backward is exact)
+        tp = cfg.tp_axis is not None and cfg.tp_size > 1
+        if tp:
+            x = _tp_region_in(x, cfg.tp_axis)
+        n_q = cfg.n_heads // cfg.tp_size
+        n_kv = cfg.n_kv_heads // cfg.tp_size
+        q = dense(n_q * hd, "wq")(x).reshape(b, t, n_q, hd)
+        k = dense(n_kv * hd, "wk")(x).reshape(b, t, n_kv, hd)
+        v = dense(n_kv * hd, "wv")(x).reshape(b, t, n_kv, hd)
         positions = pos_offset + jnp.arange(t)
         q = rotary_embed(q, positions, cfg.rope_theta)
         k = rotary_embed(k, positions, cfg.rope_theta)
@@ -152,8 +226,11 @@ class Attention(nn.Module):
             out = blockwise_attention(q, k, v, cfg.attn_block_size, causal=True)
         else:
             out = full_attention(q, k, v, causal=True)
-        out = out.reshape(b, t, cfg.n_heads * hd)
-        return dense(cfg.dim, "wo")(out)
+        out = out.reshape(b, t, n_q * hd)
+        proj = dense(cfg.dim, "wo")(out)
+        if tp:
+            proj = _tp_region_out(proj, cfg.tp_axis)
+        return proj
 
 
 class FeedForward(nn.Module):
@@ -165,9 +242,16 @@ class FeedForward(nn.Module):
         dense = lambda feats, name: nn.Dense(
             feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
             name=name)
-        gate = dense(cfg.ffn_dim, "w1")(x)
-        up = dense(cfg.ffn_dim, "w3")(x)
-        return dense(cfg.dim, "w2")(nn.silu(gate) * up)
+        tp = cfg.tp_axis is not None and cfg.tp_size > 1
+        if tp:
+            x = _tp_region_in(x, cfg.tp_axis)
+        local_ffn = cfg.ffn_dim // cfg.tp_size
+        gate = dense(local_ffn, "w1")(x)
+        up = dense(local_ffn, "w3")(x)
+        down = dense(cfg.dim, "w2")(nn.silu(gate) * up)
+        if tp:
+            down = _tp_region_out(down, cfg.tp_axis)
+        return down
 
 
 class Block(nn.Module):
@@ -242,3 +326,33 @@ class Llama(nn.Module):
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=head_dtype,
                           param_dtype=jnp.float32, name="output")(x)
         return logits.astype(jnp.float32)
+
+
+def llama_param_specs(params_or_shapes, rank_axis: str = "bf",
+                      tp_axis: str = "tp"):
+    """PartitionSpec tree for rank-major Llama params under tensor
+    parallelism: column-parallel kernels (wq/wk/wv/w1/w3) shard their
+    OUTPUT (last) dim over ``tp_axis``, row-parallel kernels (wo/w2)
+    their INPUT (second-to-last) dim; embeddings, norms, and the logits
+    head stay replicated.  Works for both unrolled and scanned layouts
+    (the kernel rank decides where the sharded dim sits).  Feed the
+    result to ``optim.functional.build_train_step(param_specs=...)``."""
+    from jax.sharding import PartitionSpec as P
+
+    column = ("wq", "wk", "wv", "w1", "w3")
+    row = ("wo", "w2")
+
+    def spec_for(path, leaf):
+        names = "/".join(
+            str(getattr(p, "key", getattr(p, "name", p))) for p in path)
+        # leaf shapes come WITHOUT the leading rank axis (pass the tree
+        # that model.init returned); the produced specs are for the
+        # rank-major global arrays, so the rank axis is prepended here
+        nd = len(leaf.shape)
+        if any(f"/{k}/" in f"/{names}/" for k in column) and nd >= 2:
+            return P(rank_axis, *([None] * (nd - 1)), tp_axis)
+        if any(f"/{k}/" in f"/{names}/" for k in row) and nd >= 2:
+            return P(rank_axis, *([None] * (nd - 2)), tp_axis, None)
+        return P(rank_axis)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params_or_shapes)

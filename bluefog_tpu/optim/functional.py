@@ -51,34 +51,39 @@ __all__ = [
     "rank_major",
     "rank_major_init",
     "rank_spec_tree",
+    "optax_state_specs",
     "consensus_distance",
 ]
 
 
-def rank_major(tree, mesh: Mesh, axis_name: str = "bf"):
+def rank_major(tree, mesh: Mesh, axis_name: str = "bf", specs=None):
     """Stack ``n`` copies of every leaf along a new leading rank axis and
     shard it over ``axis_name`` — the initial state of decentralized
     training where every rank starts from the same point (the reference
-    gets this from broadcast_parameters, torch/utility.py:26)."""
+    gets this from broadcast_parameters, torch/utility.py:26).
+    ``specs``: optional PartitionSpec tree (leading rank axis included)
+    for model-parallel leaves; default rank-sharded / replicated."""
     n = mesh.shape[axis_name]
-    sharding = NamedSharding(mesh, P(axis_name))
+    if specs is None:
+        specs = jax.tree.map(lambda _: P(axis_name), tree)
 
-    def stack(leaf):
+    def stack(leaf, spec):
         leaf = jnp.asarray(leaf)
         return jax.device_put(
-            jnp.broadcast_to(leaf[None], (n,) + leaf.shape), sharding)
+            jnp.broadcast_to(leaf[None], (n,) + leaf.shape),
+            NamedSharding(mesh, spec))
 
-    return jax.tree.map(stack, tree)
+    return jax.tree.map(stack, tree, specs)
 
 
 def rank_major_init(init_fn: Callable[[], Any], mesh: Mesh,
-                    axis_name: str = "bf"):
+                    axis_name: str = "bf", specs=None):
     """Build rank-major state directly sharded over the mesh: ``init_fn()``
     is traced once and compiled with rank-sharded outputs, so no device
     ever materializes the full unsharded ``[n, ...]`` stack — required at
-    LLM scale where a single-device staging copy would not fit HBM."""
+    LLM scale where a single-device staging copy would not fit HBM.
+    ``specs``: optional PartitionSpec tree for model-parallel leaves."""
     n = mesh.shape[axis_name]
-    sharding = NamedSharding(mesh, P(axis_name))
 
     def build():
         tree = init_fn()
@@ -87,8 +92,41 @@ def rank_major_init(init_fn: Callable[[], Any], mesh: Mesh,
             tree)
 
     shapes = jax.eval_shape(build)
-    out_shardings = jax.tree.map(lambda _: sharding, shapes)
+    if specs is None:
+        specs = jax.tree.map(lambda _: P(axis_name), shapes)
+    out_shardings = jax.tree.map(
+        lambda _, s: NamedSharding(mesh, s), shapes, specs)
     return jax.jit(build, out_shardings=out_shardings)()
+
+
+def optax_state_specs(optimizer: optax.GradientTransformation,
+                      params_shapes, param_specs,
+                      axis_name: str = "bf"):
+    """PartitionSpec tree for an optax state: any sub-tree structurally
+    identical to the param tree (momentum, Adam moments, ...) inherits
+    ``param_specs``; everything else (step counters, hyperparams) is
+    rank-replicated scalars sharded only over the rank axis."""
+    state_shapes = jax.eval_shape(optimizer.init, params_shapes)
+    params_treedef = jax.tree.structure(params_shapes)
+    default = P(axis_name)
+
+    def assign(node):
+        try:
+            if jax.tree.structure(node) == params_treedef:
+                return param_specs
+        except Exception:
+            pass
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            return type(node)(*[assign(c) for c in node])
+        if isinstance(node, tuple):
+            return tuple(assign(c) for c in node)
+        if isinstance(node, list):
+            return [assign(c) for c in node]
+        if isinstance(node, dict):
+            return {k: assign(v) for k, v in node.items()}
+        return default
+
+    return assign(state_shapes)
 
 
 def rank_spec_tree(tree, axis_name: str = "bf"):
@@ -144,6 +182,8 @@ def build_train_step(
     hierarchical_local_size: Optional[int] = None,
     sp_axis: Optional[str] = None,
     batch_specs: Any = None,
+    param_specs: Any = None,
+    opt_state_specs: Any = None,
     donate: bool = True,
     has_aux: bool = False,
     compress: Optional[str] = None,
@@ -304,11 +344,16 @@ def build_train_step(
     p_rank = P(axis_name)
     if batch_specs is None:
         batch_specs = p_rank
+    # Model-parallel (e.g. tensor-parallel) param layouts: per-leaf specs
+    # carry the extra mesh axes (see models.llama.llama_param_specs);
+    # grads/updates follow params automatically under shard_map.
+    p_params = param_specs if param_specs is not None else p_rank
+    p_opt = opt_state_specs if opt_state_specs is not None else p_rank
     sm = jax.shard_map(
         wrapped,
         mesh=mesh,
-        in_specs=(p_rank, p_rank, p_rank, batch_specs, P()),
-        out_specs=(p_rank, p_rank, p_rank, p_rank),
+        in_specs=(p_params, p_rank, p_opt, batch_specs, P()),
+        out_specs=(p_params, p_rank, p_opt, p_rank),
         check_vma=False,
     )
     donate_argnums = (0, 1, 2) if donate else ()

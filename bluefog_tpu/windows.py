@@ -9,10 +9,12 @@ however, is simply per-in-neighbor receive buffers
 that is exactly what this module keeps, as device-resident mailboxes:
 
 * ``value``     [n, *shape]      rank-major window tensors
-* ``mailbox``   [n, n, *shape]   slot [dst, src] = what src last sent to dst
-* ``versions``  [n, n] int32     bumped on put/get/accumulate, cleared on update
+* ``mailbox``   [n, d, *shape]   slot [dst, k] = what dst's k-th (sorted)
+  in-neighbor last sent (d = max in-degree: in-degree-bounded, like the
+  reference's per-in-neighbor tensors — never a dense [n, n] buffer)
+* ``versions``  [n, d] int32     bumped on put/get/accumulate, cleared on update
 * ``p``         [n] f64          associated push-sum scalar (init 1.0)
-* ``p_mailbox`` [n, n] f64       mailbox for p
+* ``p_mailbox`` [n, d] f64       mailbox for p
 
 ``win_put`` lowers to one ``lax.ppermute`` per shift class of the destination
 set, writing into the receiver's slot for the sender; ``win_update`` is a
@@ -49,7 +51,13 @@ def _p_dtype():
 
 
 class Window:
-    """Device-resident state for one named window."""
+    """Device-resident state for one named window.
+
+    Mailboxes are IN-DEGREE-BOUNDED: per rank the receive buffer has
+    ``max_in_degree`` slots ordered by sorted in-neighbor rank (exactly
+    the reference's WinTorchStorageManager, which allocates one local
+    tensor per in-neighbor, mpi_win_ops.cc:83-105) — per-shard memory is
+    O(d * |x|), never the dense O(n * |x|) that would OOM a pod."""
 
     def __init__(
         self,
@@ -64,22 +72,6 @@ class Window:
         self.shape = value.shape[1:]
         self.dtype = value.dtype
         self.value = value
-        # Mailbox init: copy of the creating tensor, or zeros
-        # (reference torch/mpi_win_ops.cc:88-100 RegisterWinName).
-        if zero_init:
-            mailbox = jnp.zeros((n,) + value.shape, dtype=value.dtype)
-        else:
-            # slot [dst, src] starts as src's value (a fresh put's no-op state)
-            mailbox = jnp.broadcast_to(value[None], (n,) + value.shape)
-        sharding = NamedSharding(ctx.mesh, P(AXIS))
-        self.mailbox = jax.device_put(mailbox, sharding)
-        self.versions = jax.device_put(
-            jnp.zeros((n, n), dtype=jnp.int32), sharding
-        )
-        self.p = jax.device_put(jnp.ones((n,), dtype=_p_dtype()), sharding)
-        self.p_mailbox = jax.device_put(
-            jnp.zeros((n, n), dtype=_p_dtype()), sharding
-        )
         # The topology is pinned while windows are alive (reference
         # basics.py refuses set_topology with registered windows).
         self.in_neighbors = {
@@ -88,6 +80,43 @@ class Window:
         self.out_neighbors = {
             r: ctx.out_neighbor_ranks(r) for r in range(n)
         }
+        self.in_lists = [sorted(self.in_neighbors[r]) for r in range(n)]
+        self.d_max = max((len(l) for l in self.in_lists), default=0) or 1
+
+        sharding = NamedSharding(ctx.mesh, P(AXIS))
+        # Mailbox init: each slot holds its in-neighbor's value (a fresh
+        # put's no-op state), or zeros
+        # (reference torch/mpi_win_ops.cc:88-100 RegisterWinName).
+        if zero_init:
+            mailbox = jnp.zeros((n, self.d_max) + self.shape,
+                                dtype=value.dtype)
+            self.mailbox = jax.device_put(mailbox, sharding)
+        else:
+            from bluefog_tpu.parallel import collectives as C
+
+            spec = ctx.topology_spec()
+            d_spec = max((len(l) for l in C.in_neighbor_lists(spec)),
+                         default=0)
+
+            def fill(x):
+                out = C.neighbor_allgather_padded(x[0], spec, AXIS)[None]
+                pad = self.d_max - d_spec
+                if pad > 0:  # degenerate edgeless topology: d_max floor 1
+                    out = jnp.concatenate(
+                        [out, jnp.zeros(out.shape[:1] + (pad,)
+                                        + out.shape[2:], out.dtype)], 1)
+                return out
+
+            sm = jax.shard_map(fill, mesh=ctx.mesh, in_specs=P(AXIS),
+                               out_specs=P(AXIS), check_vma=False)
+            self.mailbox = jax.jit(sm)(value)
+        self.versions = jax.device_put(
+            jnp.zeros((n, self.d_max), dtype=jnp.int32), sharding
+        )
+        self.p = jax.device_put(jnp.ones((n,), dtype=_p_dtype()), sharding)
+        self.p_mailbox = jax.device_put(
+            jnp.zeros((n, self.d_max), dtype=_p_dtype()), sharding
+        )
 
 
 class WindowManager:
@@ -251,11 +280,12 @@ class WindowManager:
                x.shape, str(x.dtype))
         fn = ctx._op_cache.get(key)
         if fn is None:
+            tables = _slot_tables(structure, win.in_lists)
             fn = jax.jit(
                 jax.shard_map(
                     lambda xx, mb, vv, pp, pmb, wv, sv: _put_kernel(
-                        xx, mb, vv, pp, pmb, wv, sv, structure, accumulate,
-                        associated_p
+                        xx, mb, vv, pp, pmb, wv, sv, structure, tables,
+                        accumulate, associated_p
                     ),
                     mesh=ctx.mesh,
                     in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
@@ -291,10 +321,12 @@ class WindowManager:
                win.value.shape, str(win.value.dtype))
         fn = ctx._op_cache.get(key)
         if fn is None:
+            tables = _slot_tables(structure, win.in_lists)
             fn = jax.jit(
                 jax.shard_map(
                     lambda xx, mb, vv, pp, pmb, wv: _get_kernel(
-                        xx, mb, vv, pp, pmb, wv, structure, associated_p
+                        xx, mb, vv, pp, pmb, wv, structure, tables,
+                        associated_p
                     ),
                     mesh=ctx.mesh,
                     in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
@@ -385,11 +417,12 @@ class WindowManager:
                win.value.shape, str(win.value.dtype))
         fn = ctx._op_cache.get(key)
         if fn is None:
+            tables = _slot_tables(structure, win.in_lists)
             fn = jax.jit(
                 jax.shard_map(
                     lambda xx, mb, vv, pp, pmb, wm, sv: _update_kernel(
-                        xx, mb, vv, pp, pmb, wm, sv, structure, reset,
-                        associated_p
+                        xx, mb, vv, pp, pmb, wm, sv, structure, tables,
+                        reset, associated_p
                     ),
                     mesh=ctx.mesh,
                     in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
@@ -419,7 +452,8 @@ class WindowManager:
         win = self.window(name)
         r = self.ctx.rank() if rank is None else rank
         vers = host_fetch(win.versions)
-        return {s: int(vers[r, s]) for s in win.in_neighbors[r]}
+        return {s: int(vers[r, win.in_lists[r].index(s)])
+                for s in win.in_neighbors[r]}
 
     def associated_p(self, name: str, rank: Optional[int] = None) -> float:
         win = self.window(name)
@@ -446,6 +480,25 @@ def _edge_structure(spec: DynamicTopology) -> DynamicTopology:
         spec.size, {e: 1.0 for e in spec.edges})
 
 
+def _slot_tables(structure: DynamicTopology, in_lists) -> list:
+    """Per shift class, a length-n table: the mailbox SLOT rank d uses for
+    this class's incoming edge (position of the source in d's sorted
+    in-neighbor list), or -1 when d has no edge in the class.  Host-side,
+    trace-time; ``in_lists`` is the WINDOW topology's in-neighbor lists
+    (op edge sets are validated subsets of it)."""
+    n = structure.size
+    tables = []
+    for cls in structure.shift_classes:
+        tbl = []
+        for dst in range(n):
+            if cls.recv_weights[dst] != 0.0:
+                tbl.append(in_lists[dst].index((dst - cls.shift) % n))
+            else:
+                tbl.append(-1)
+        tables.append(tuple(tbl))
+    return tables
+
+
 def _class_recv_weights(spec: DynamicTopology) -> jnp.ndarray:
     """[n_classes, n] f32: row c, entry d = the weight rank d applies to
     what it receives through shift class c (0 where no edge).  Class
@@ -458,7 +511,7 @@ def _class_recv_weights(spec: DynamicTopology) -> jnp.ndarray:
 
 
 def _put_kernel(x, mailbox, versions, p, p_mailbox, wvecs, self_weights,
-                structure, accumulate, associated_p):
+                structure, tables, accumulate, associated_p):
     n = structure.size
     idx = lax.axis_index(AXIS)
     xs = x[0]
@@ -473,20 +526,21 @@ def _put_kernel(x, mailbox, versions, p, p_mailbox, wvecs, self_weights,
         sent = lax.ppermute(
             (xs.astype(jnp.float32) * w_send).astype(xs.dtype),
             AXIS, cls.perm)
-        has = jnp.asarray(cls.recv_weights, jnp.float32)[idx] != 0.0
-        src = (idx - cls.shift) % n
-        slot = lax.dynamic_index_in_dim(mb, src, 0, keepdims=False)
-        new_slot = jnp.where(has, slot + sent if accumulate else sent, slot)
-        mb = lax.dynamic_update_index_in_dim(mb, new_slot, src, 0)
+        slot_c = jnp.asarray(tables[c], jnp.int32)[idx]
+        has = slot_c >= 0
+        slot = jnp.maximum(slot_c, 0)
+        cur = lax.dynamic_index_in_dim(mb, slot, 0, keepdims=False)
+        new_slot = jnp.where(has, cur + sent if accumulate else sent, cur)
+        mb = lax.dynamic_update_index_in_dim(mb, new_slot, slot, 0)
         ver = lax.dynamic_update_index_in_dim(
-            ver, jnp.where(has, ver[src] + 1, ver[src]), src, 0
+            ver, jnp.where(has, ver[slot] + 1, ver[slot]), slot, 0
         )
         if associated_p:
             p_sent = lax.ppermute(pv * w_send.astype(pv.dtype),
                                   AXIS, cls.perm)
-            p_slot = pmb[src]
+            p_slot = pmb[slot]
             new_p = jnp.where(has, p_slot + p_sent if accumulate else p_sent, p_slot)
-            pmb = lax.dynamic_update_index_in_dim(pmb, new_p, src, 0)
+            pmb = lax.dynamic_update_index_in_dim(pmb, new_p, slot, 0)
     sw = self_weights.astype(jnp.float32)[idx]
     new_x = (xs.astype(jnp.float32) * sw).astype(xs.dtype)
     new_p_val = pv * sw.astype(pv.dtype) if associated_p else pv
@@ -494,8 +548,7 @@ def _put_kernel(x, mailbox, versions, p, p_mailbox, wvecs, self_weights,
 
 
 def _get_kernel(x, mailbox, versions, p, p_mailbox, wvecs, structure,
-                associated_p):
-    n = structure.size
+                tables, associated_p):
     idx = lax.axis_index(AXIS)
     xs = x[0]
     mb = mailbox[0]
@@ -504,57 +557,60 @@ def _get_kernel(x, mailbox, versions, p, p_mailbox, wvecs, structure,
     pmb = p_mailbox[0]
     for c, cls in enumerate(structure.shift_classes):
         fetched = lax.ppermute(xs, AXIS, cls.perm)
-        src = (idx - cls.shift) % n
         # receiver-side scale: my weight for this class
         recv_w = wvecs[c, idx].astype(jnp.float32)
-        has = jnp.asarray(cls.recv_weights, jnp.float32)[idx] != 0.0
-        slot = lax.dynamic_index_in_dim(mb, src, 0, keepdims=False)
+        slot_c = jnp.asarray(tables[c], jnp.int32)[idx]
+        has = slot_c >= 0
+        slot = jnp.maximum(slot_c, 0)
+        cur = lax.dynamic_index_in_dim(mb, slot, 0, keepdims=False)
         scaled = (fetched.astype(jnp.float32) * recv_w).astype(xs.dtype)
         mb = lax.dynamic_update_index_in_dim(
-            mb, jnp.where(has, scaled, slot), src, 0
+            mb, jnp.where(has, scaled, cur), slot, 0
         )
         ver = lax.dynamic_update_index_in_dim(
-            ver, jnp.where(has, ver[src] + 1, ver[src]), src, 0
+            ver, jnp.where(has, ver[slot] + 1, ver[slot]), slot, 0
         )
         if associated_p:
             p_fetched = lax.ppermute(pv, AXIS, cls.perm)
             pmb = lax.dynamic_update_index_in_dim(
                 pmb,
-                jnp.where(has, p_fetched * recv_w.astype(pv.dtype), pmb[src]),
-                src, 0,
+                jnp.where(has, p_fetched * recv_w.astype(pv.dtype),
+                          pmb[slot]),
+                slot, 0,
             )
     return (mb[None], ver[None], pmb[None])
 
 
 def _update_kernel(x, mailbox, versions, p, p_mailbox, wvecs, self_weights,
-                   structure, reset, associated_p):
-    n = structure.size
+                   structure, tables, reset, associated_p):
     idx = lax.axis_index(AXIS)
     xs = x[0]
     mb = mailbox[0]
     ver = versions[0]
     pv = p[0]
     pmb = p_mailbox[0]
+    d_max = mb.shape[0]
 
     self_w = self_weights.astype(jnp.float32)[idx]
     acc = xs.astype(jnp.float32) * self_w
     new_p = pv * self_w.astype(pv.dtype) if associated_p else pv
     # structural inclusion mask per slot (which slots this update
     # consumes) — a declared 0.0-weight edge still counts as read
-    included = jnp.zeros((n,), bool)
+    included = jnp.zeros((d_max,), bool)
     for c, cls in enumerate(structure.shift_classes):
-        src = (idx - cls.shift) % n
-        has = jnp.asarray(cls.recv_weights, jnp.float32)[idx] != 0.0
+        slot_c = jnp.asarray(tables[c], jnp.int32)[idx]
+        has = slot_c >= 0
+        slot = jnp.maximum(slot_c, 0)
         w = jnp.where(has, wvecs[c, idx], 0.0)
-        slot = lax.dynamic_index_in_dim(mb, src, 0, keepdims=False)
-        acc = acc + slot.astype(jnp.float32) * w
+        cur = lax.dynamic_index_in_dim(mb, slot, 0, keepdims=False)
+        acc = acc + cur.astype(jnp.float32) * w
         if associated_p:
-            new_p = new_p + pmb[src] * w.astype(pv.dtype)
-        included = included.at[src].set(included[src] | has)
+            new_p = new_p + pmb[slot] * w.astype(pv.dtype)
+        included = included.at[slot].set(included[slot] | has)
     new_x = acc.astype(xs.dtype)
 
     if reset:
-        shape_ones = (n,) + (1,) * (mb.ndim - 1)
+        shape_ones = (d_max,) + (1,) * (mb.ndim - 1)
         keep = (~included).astype(mb.dtype).reshape(shape_ones)
         mb = mb * keep
         ver = jnp.where(included, 0, ver)

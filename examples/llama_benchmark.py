@@ -40,6 +40,8 @@ parser.add_argument("--dist-optimizer", default="neighbor_allreduce",
                              "local"])
 parser.add_argument("--sp", type=int, default=1,
                     help="sequence-parallel ways (ring attention)")
+parser.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel ways (Megatron column->row)")
 parser.add_argument("--attn-impl", default="xla", choices=["xla", "flash"])
 parser.add_argument("--scan-layers", action="store_true",
                     help="nn.scan the decoder stack (O(1) compile in depth)")
@@ -60,6 +62,8 @@ def make_config():
     base = dict(remat=not args.no_remat, scan_layers=args.scan_layers,
                 remat_policy=args.remat_policy,
                 logits_dot_in_fp32=not args.bf16_logits)
+    if args.tp > 1:
+        base.update(tp_axis="tp", tp_size=args.tp)
     if args.sp > 1:
         base.update(attn_mode="ring", sp_axis="sp",
                     attn_impl=args.attn_impl)
@@ -81,11 +85,12 @@ def make_config():
 def main():
     devices = jax.devices()
     n_total = len(devices)
-    n_sp = args.sp
-    assert n_total % n_sp == 0, (n_total, n_sp)
+    n_sp, n_tp = args.sp, args.tp
+    assert n_total % (n_sp * n_tp) == 0, (n_total, n_sp, n_tp)
     assert args.seq_len % n_sp == 0, (args.seq_len, n_sp)
-    n_dp = n_total // n_sp
-    mesh = Mesh(np.array(devices).reshape(n_dp, n_sp), ("bf", "sp"))
+    n_dp = n_total // (n_sp * n_tp)
+    mesh = Mesh(np.array(devices).reshape(n_dp, n_tp, n_sp),
+                ("bf", "tp", "sp"))
     cfg = make_config()
     model = models.Llama(cfg)
     t_local = args.seq_len // n_sp
@@ -111,9 +116,26 @@ def main():
 
     opt = optax.sgd(1e-3, momentum=0.9)
     batch_specs = P("bf", None, "sp") if n_sp > 1 else P("bf")
+    # ONE unsharded config override serves both the spec derivation here
+    # and the sharded init below
+    init_model = models.Llama(
+        models.LlamaConfig(**{**cfg.__dict__, "attn_mode": "full",
+                              "attn_impl": "xla", "sp_axis": None,
+                              "tp_axis": None, "tp_size": 1}))
+    if n_tp > 1:
+        from bluefog_tpu.models.llama import llama_param_specs
+
+        shapes = jax.eval_shape(
+            lambda: init_model.init(jax.random.PRNGKey(0),
+                                    jnp.zeros((1, 8), jnp.int32)))
+        param_specs = llama_param_specs(shapes)
+        opt_state_specs = F.optax_state_specs(opt, shapes, param_specs)
+    else:
+        param_specs = opt_state_specs = None
     step_fn = F.build_train_step(
         loss_fn, opt, mesh, comm_mode=comm_mode,
         sp_axis="sp" if n_sp > 1 else None, batch_specs=batch_specs,
+        param_specs=param_specs, opt_state_specs=opt_state_specs,
         **topo_kwargs)
 
     rng = np.random.RandomState(0)
@@ -126,15 +148,15 @@ def main():
     # sharded init: params materialize already rank-major over the mesh —
     # no single-device staging of the full model (matters at 1b/8b scale)
     init_tokens = jnp.zeros((args.batch_size, min(8, args.seq_len)), jnp.int32)
-    init_model = models.Llama(
-        models.LlamaConfig(**{**cfg.__dict__, "attn_mode": "full",
-                              "attn_impl": "xla", "sp_axis": None}))
 
     def init_state():
         base = init_model.init(jax.random.PRNGKey(0), init_tokens)
         return {"params": base, "opt": opt.init(base)}
 
-    state = F.rank_major_init(init_state, mesh)
+    state_specs = None
+    if n_tp > 1:
+        state_specs = {"params": param_specs, "opt": opt_state_specs}
+    state = F.rank_major_init(init_state, mesh, specs=state_specs)
     params, opt_state = state["params"], state["opt"]
     n_params = sum(x.size for x in jax.tree.leaves(params)) // max(
         mesh.shape["bf"], 1)
@@ -179,7 +201,8 @@ def main():
                             * step_tokens)
     result = {
         "model": args.model, "params": n_params,
-        "optimizer": args.dist_optimizer, "mesh": f"{n_dp}dp x {n_sp}sp",
+        "optimizer": args.dist_optimizer,
+        "mesh": f"{n_dp}dp x {n_tp}tp x {n_sp}sp",
         "attn": cfg.attn_mode + "/" + cfg.attn_impl,
         "remat": cfg.remat, "scan_layers": cfg.scan_layers,
         "tokens_per_sec": round(tokens_per_sec, 1),

@@ -104,3 +104,54 @@ def test_padded_gather_memory_is_in_degree_sized(report):
 def test_padded_gather_executes_at_128_ranks(report):
     assert report["exec_correct"]
     assert report["out_shape"] == [128, 7, 4, 2]
+
+
+_WIN_SCRIPT = textwrap.dedent("""
+    import json, os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=128"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import bluefog_tpu as bf
+    from bluefog_tpu.topology.graphs import ExponentialTwoGraph
+
+    bf.init(topology_fn=lambda n: ExponentialTwoGraph(n))
+    n = bf.size()
+    x = bf.from_rank_values(lambda r: np.full((64,), float(r), np.float32))
+    bf.win_create(x, "w")
+    from bluefog_tpu import api as bf_api
+    win = bf_api._wm().window("w")
+    err0 = float(np.abs(np.asarray(bf.to_rank_values(x))
+                        - (n - 1) / 2).max())
+    for _ in range(10):
+        bf.win_put(x, "w")
+        x = bf.win_update("w")
+    val = np.asarray(bf.to_rank_values(x))
+    err = float(np.abs(val - (n - 1) / 2).max())
+    print(json.dumps({
+        "n": n, "d_max": win.d_max,
+        "mailbox_shape": list(win.mailbox.shape),
+        "versions_shape": list(win.versions.shape),
+        "err0": err0, "err": err,
+    }))
+""")
+
+
+def test_window_mailboxes_are_in_degree_bounded_at_128_ranks():
+    """Window mailboxes allocate max_in_degree slots per rank (like the
+    reference's per-in-neighbor tensors, mpi_win_ops.cc:83-105) — at 128
+    ranks on the exp2 graph that is 7 slots, not 128; the gossip loop
+    still mixes correctly."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([sys.executable, "-c", _WIN_SCRIPT], env=env,
+                          capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["n"] == 128
+    assert rec["d_max"] == 7
+    assert rec["mailbox_shape"] == [128, 7, 64]
+    assert rec["versions_shape"] == [128, 7]
+    # 10 gossip rounds contract the disagreement substantially
+    assert rec["err"] < rec["err0"] / 8, rec

@@ -310,8 +310,10 @@ def test_put_weight_variation_changes_values_not_programs(bf_ctx):
         bf.win_put(x, "w_wval", self_weight=1.0,
                    dst_weights=[{(r + 1) % SIZE: w} for r in range(SIZE)])
         from bluefog_tpu import api as bf_api
-        mb = np.asarray(bf_api._wm().window("w_wval").mailbox)
+        win = bf_api._wm().window("w_wval")
+        mb = np.asarray(win.mailbox)
         for r in range(SIZE):
             src = (r - 1) % SIZE
-            np.testing.assert_allclose(mb[r, src], w * src, rtol=1e-6)
+            slot = win.in_lists[r].index(src)
+            np.testing.assert_allclose(mb[r, slot], w * src, rtol=1e-6)
     bf.win_free("w_wval")
