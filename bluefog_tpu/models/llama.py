@@ -21,6 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from flax import linen as nn
 
 from bluefog_tpu.parallel.ring_attention import (
@@ -59,6 +60,20 @@ class LlamaConfig:
     # (SURVEY.md §2.3: TP absent there).
     tp_axis: Optional[str] = None
     tp_size: int = 1
+    # Mixture-of-Experts FFN with expert parallelism (Mixtral-style;
+    # another capability past the reference's DP-only scope).
+    # ``n_experts > 0`` replaces the dense FFN with ``moe_top_k``-routed
+    # experts; experts shard over ``ep_axis`` (``ep_size`` shards), each
+    # shard evaluating its local experts on the replicated token stream
+    # and the outputs merging through ONE psum per layer (the same f/g
+    # conjugate pair as TP keeps the backward exact).  Static capacity
+    # ``capacity_factor * tokens * top_k / n_experts`` per expert keeps
+    # shapes XLA-friendly; overflow tokens fall through the residual.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    ep_axis: Optional[str] = None
+    ep_size: int = 1
+    capacity_factor: float = 1.25
     remat: bool = False
     # Compile the decoder stack as ONE nn.scan'd block instead of L unrolled
     # copies: params gain a leading [n_layers] axis, trace/compile time goes
@@ -90,6 +105,22 @@ class LlamaConfig:
                     raise ValueError(
                         f"{name} ({val}) must divide by tp_size "
                         f"({self.tp_size})")
+        if self.ep_size > 1:
+            if self.ep_axis is None:
+                raise ValueError("ep_size > 1 requires ep_axis")
+            if not self.n_experts:
+                raise ValueError("ep_size > 1 requires n_experts > 0")
+        if self.n_experts:
+            if self.n_experts % self.ep_size:
+                raise ValueError(
+                    f"n_experts ({self.n_experts}) must divide by ep_size "
+                    f"({self.ep_size})")
+            if self.moe_top_k > self.n_experts:
+                raise ValueError("moe_top_k exceeds n_experts")
+            if self.tp_size > 1:
+                raise ValueError(
+                    "MoE + tensor parallelism in one config is not "
+                    "supported yet (experts are not tp-sharded)")
 
     @property
     def head_dim(self) -> int:
@@ -254,6 +285,119 @@ class FeedForward(nn.Module):
         return down
 
 
+class MoEFeedForward(nn.Module):
+    """Top-k routed mixture-of-experts SwiGLU FFN with expert parallelism.
+
+    TPU-first design: routing is computed identically on every ep shard
+    (tokens are replicated over ``ep_axis``), dispatch/combine are static
+    einsums against a capacity-bounded one-hot tensor (no dynamic shapes,
+    no host round trips), each shard evaluates only its LOCAL experts as
+    one batched ``[local_E, capacity, d]`` einsum on the MXU, and the
+    shards' partial outputs merge with ONE psum (through the Megatron-
+    style g operator; the token stream enters through f so gradients are
+    exact — see ``_tp_region_in/_tp_region_out``).  Tokens over an
+    expert's capacity are dropped (they ride the residual), the standard
+    static-shape MoE contract.
+    """
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, t, d = x.shape
+        E = cfg.n_experts
+        local_E = E // cfg.ep_size
+        ep = cfg.ep_axis is not None and cfg.ep_size > 1
+        s = b * t
+        # Two independent paths enter the expert region, each wrapped in
+        # its OWN f operator (identity fwd / psum bwd) so every backward
+        # contribution is summed over ep exactly once: the token stream
+        # (expert inputs) and the router logits.  The router itself runs
+        # on the raw x OUTSIDE the region — it is a replicated param, and
+        # wrapping its output (not its input) is what makes its gradient
+        # the full cross-expert sum instead of a per-shard partial.
+        flat_raw = x.reshape(s, d)
+        if ep:
+            x = _tp_region_in(x, cfg.ep_axis)
+        flat = x.reshape(s, d)
+        cap = max(1, int(cfg.capacity_factor * s * cfg.moe_top_k / E))
+
+        logits_raw = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                              param_dtype=jnp.float32, name="router")(
+                                  flat_raw.astype(jnp.float32))
+        logits = _tp_region_in(logits_raw, cfg.ep_axis) if ep else logits_raw
+        probs = jax.nn.softmax(logits, axis=-1)  # [s, E]
+
+        # top-k selection: k rounds of argmax with masking (k is tiny)
+        masked = probs
+        combine = jnp.zeros((s, E, cap), jnp.float32)
+        counts = jnp.zeros((E,), jnp.int32)
+        for _ in range(cfg.moe_top_k):
+            idx = jnp.argmax(masked, axis=-1)                   # [s]
+            gate = jnp.take_along_axis(probs, idx[:, None],
+                                       axis=-1)[:, 0]           # [s]
+            onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)    # [s, E]
+            # position of each token within its expert's queue, offset by
+            # what previous rounds already enqueued
+            pos = jnp.cumsum(onehot, axis=0) - onehot + counts[None, :]
+            pos_tok = jnp.sum(pos * onehot, axis=-1)            # [s]
+            keep = pos_tok < cap
+            combine = combine + (
+                gate[:, None, None]
+                * jax.nn.one_hot(idx, E)[:, :, None]
+                * jax.nn.one_hot(pos_tok, cap)[:, None, :]
+                * keep[:, None, None])
+            counts = counts + jnp.sum(onehot * keep[:, None].astype(
+                jnp.int32), axis=0)
+            masked = masked * (1.0 - onehot.astype(masked.dtype))
+
+        dispatch = (combine > 0.0).astype(cfg.dtype)  # [s, E, cap]
+        # my shard's expert slice
+        if ep:
+            e_lo = jax.lax.axis_index(cfg.ep_axis) * local_E
+        else:
+            e_lo = 0
+        disp_local = lax.dynamic_slice_in_dim(dispatch, e_lo, local_E, 1)
+        comb_local = lax.dynamic_slice_in_dim(
+            combine.astype(cfg.dtype), e_lo, local_E, 1)
+
+        expert_in = jnp.einsum("sec,sd->ecd", disp_local,
+                               flat.astype(cfg.dtype))
+        h = cfg.ffn_dim
+        w1 = self.param("w1", nn.initializers.lecun_normal(
+            in_axis=-2, out_axis=-1), (local_E, d, h), jnp.float32)
+        w3 = self.param("w3", nn.initializers.lecun_normal(
+            in_axis=-2, out_axis=-1), (local_E, d, h), jnp.float32)
+        w2 = self.param("w2", nn.initializers.lecun_normal(
+            in_axis=-2, out_axis=-1), (local_E, h, d), jnp.float32)
+        gate_h = jnp.einsum("ecd,edh->ech", expert_in, w1.astype(cfg.dtype))
+        up_h = jnp.einsum("ecd,edh->ech", expert_in, w3.astype(cfg.dtype))
+        expert_out = jnp.einsum("ech,ehd->ecd", nn.silu(gate_h) * up_h,
+                                w2.astype(cfg.dtype))
+        out = jnp.einsum("ecd,sec->sd", expert_out, comb_local)
+        if ep:
+            out = _tp_region_out(out, cfg.ep_axis)
+        # load-balancing auxiliary loss (Switch Transformer eq. 4) —
+        # exposed via sow; trainers may add cfg-weighted aux to the loss.
+        # (Not sown under scan_layers: the scanned block would need an
+        # intermediates axis declaration for a diagnostics-only value.)
+        if not cfg.scan_layers:
+            # computed from the UNWRAPPED logits: the aux term is a
+            # replicated computation outside the expert region, so adding
+            # it to the loss gives the unsharded router gradient exactly
+            # (through the f-wrapped logits its backward psum would scale
+            # the aux contribution by ep_size)
+            probs_raw = jax.nn.softmax(logits_raw, axis=-1)
+            frac_tokens = jnp.mean(
+                jax.nn.one_hot(jnp.argmax(probs_raw, -1), E,
+                               dtype=jnp.float32), axis=0)
+            frac_probs = jnp.mean(probs_raw, axis=0)
+            self.sow("intermediates", "moe_aux_loss",
+                     E * jnp.sum(frac_tokens * frac_probs))
+        return out.reshape(b, t, d).astype(x.dtype)
+
+
 class Block(nn.Module):
     cfg: LlamaConfig
 
@@ -261,7 +405,9 @@ class Block(nn.Module):
     def __call__(self, x, pos_offset):
         x = x + Attention(self.cfg, name="attention")(
             RMSNorm(self.cfg.norm_eps, name="attention_norm")(x), pos_offset)
-        x = x + FeedForward(self.cfg, name="feed_forward")(
+        ffn_cls = MoEFeedForward if self.cfg.n_experts else FeedForward
+        name = "moe_ffn" if self.cfg.n_experts else "feed_forward"
+        x = x + ffn_cls(self.cfg, name=name)(
             RMSNorm(self.cfg.norm_eps, name="ffn_norm")(x))
         return x
 
@@ -329,14 +475,17 @@ class Llama(nn.Module):
 
 
 def llama_param_specs(params_or_shapes, rank_axis: str = "bf",
-                      tp_axis: str = "tp"):
-    """PartitionSpec tree for rank-major Llama params under tensor
+                      tp_axis: Optional[str] = "tp",
+                      ep_axis: Optional[str] = "ep"):
+    """PartitionSpec tree for rank-major Llama params under model
     parallelism: column-parallel kernels (wq/wk/wv/w1/w3) shard their
     OUTPUT (last) dim over ``tp_axis``, row-parallel kernels (wo/w2)
-    their INPUT (second-to-last) dim; embeddings, norms, and the logits
-    head stay replicated.  Works for both unrolled and scanned layouts
-    (the kernel rank decides where the sharded dim sits).  Feed the
-    result to ``optim.functional.build_train_step(param_specs=...)``."""
+    their INPUT (second-to-last) dim; MoE expert tensors (under
+    ``moe_ffn``) shard their EXPERT (first) dim over ``ep_axis``, the
+    router and everything else (embeddings, norms, logits head) stay
+    replicated.  Works for both unrolled and scanned layouts (the kernel
+    rank decides where the sharded dim sits).  Feed the result to
+    ``optim.functional.build_train_step(param_specs=...)``."""
     from jax.sharding import PartitionSpec as P
 
     column = ("wq", "wk", "wv", "w1", "w3")
@@ -349,6 +498,13 @@ def llama_param_specs(params_or_shapes, rank_axis: str = "bf",
         # that model.init returned); the produced specs are for the
         # rank-major global arrays, so the rank axis is prepended here
         nd = len(leaf.shape)
+        if "/moe_ffn/" in f"/{names}/":
+            if ep_axis is None or "/router/" in f"/{names}/" or nd < 3:
+                return P(rank_axis)
+            # [E, in, out] (or [L, E, in, out] scanned): shard E
+            return P(rank_axis, *([None] * (nd - 3)), ep_axis, None, None)
+        if tp_axis is None:
+            return P(rank_axis)
         if any(f"/{k}/" in f"/{names}/" for k in column) and nd >= 2:
             return P(rank_axis, *([None] * (nd - 1)), tp_axis)
         if any(f"/{k}/" in f"/{names}/" for k in row) and nd >= 2:

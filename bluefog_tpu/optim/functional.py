@@ -110,10 +110,21 @@ def optax_state_specs(optimizer: optax.GradientTransformation,
     params_treedef = jax.tree.structure(params_shapes)
     default = P(axis_name)
 
+    def match_specs(node):
+        """param_specs, but leaves whose SHAPE differs from the matching
+        param fall back to the default — factored optimizers (adafactor)
+        keep param-structured subtrees with rank-reduced leaves, and a
+        model-parallel spec longer than the leaf's rank would fail at
+        device_put."""
+        return jax.tree.map(
+            lambda st, ps, spec: spec if tuple(st.shape) == tuple(ps.shape)
+            else default,
+            node, params_shapes, param_specs)
+
     def assign(node):
         try:
             if jax.tree.structure(node) == params_treedef:
-                return param_specs
+                return match_specs(node)
         except Exception:
             pass
         if isinstance(node, tuple) and hasattr(node, "_fields"):

@@ -42,6 +42,10 @@ parser.add_argument("--sp", type=int, default=1,
                     help="sequence-parallel ways (ring attention)")
 parser.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel ways (Megatron column->row)")
+parser.add_argument("--experts", type=int, default=0,
+                    help="mixture-of-experts FFN with this many experts")
+parser.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel ways (needs --experts)")
 parser.add_argument("--attn-impl", default="xla", choices=["xla", "flash"])
 parser.add_argument("--scan-layers", action="store_true",
                     help="nn.scan the decoder stack (O(1) compile in depth)")
@@ -64,6 +68,10 @@ def make_config():
                 logits_dot_in_fp32=not args.bf16_logits)
     if args.tp > 1:
         base.update(tp_axis="tp", tp_size=args.tp)
+    if args.experts:
+        base.update(n_experts=args.experts)
+        if args.ep > 1:
+            base.update(ep_axis="ep", ep_size=args.ep)
     if args.sp > 1:
         base.update(attn_mode="ring", sp_axis="sp",
                     attn_impl=args.attn_impl)
@@ -85,12 +93,18 @@ def make_config():
 def main():
     devices = jax.devices()
     n_total = len(devices)
-    n_sp, n_tp = args.sp, args.tp
-    assert n_total % (n_sp * n_tp) == 0, (n_total, n_sp, n_tp)
+    n_sp, n_tp, n_ep = args.sp, args.tp, args.ep
+    assert n_tp == 1 or n_ep == 1, "tp and ep do not compose yet"
+    assert n_ep == 1 or args.experts > 0, \
+        "--ep > 1 without --experts would replicate the dense model " \
+        "across the ep axis (wasted devices); add --experts N"
+    n_model = n_tp * n_ep
+    assert n_total % (n_sp * n_model) == 0, (n_total, n_sp, n_tp, n_ep)
     assert args.seq_len % n_sp == 0, (args.seq_len, n_sp)
-    n_dp = n_total // (n_sp * n_tp)
-    mesh = Mesh(np.array(devices).reshape(n_dp, n_tp, n_sp),
-                ("bf", "tp", "sp"))
+    n_dp = n_total // (n_sp * n_model)
+    model_axis = "ep" if n_ep > 1 else "tp"
+    mesh = Mesh(np.array(devices).reshape(n_dp, n_model, n_sp),
+                ("bf", model_axis, "sp"))
     cfg = make_config()
     model = models.Llama(cfg)
     t_local = args.seq_len // n_sp
@@ -121,14 +135,17 @@ def main():
     init_model = models.Llama(
         models.LlamaConfig(**{**cfg.__dict__, "attn_mode": "full",
                               "attn_impl": "xla", "sp_axis": None,
-                              "tp_axis": None, "tp_size": 1}))
-    if n_tp > 1:
+                              "tp_axis": None, "tp_size": 1,
+                              "ep_axis": None, "ep_size": 1}))
+    if n_model > 1:
         from bluefog_tpu.models.llama import llama_param_specs
 
         shapes = jax.eval_shape(
             lambda: init_model.init(jax.random.PRNGKey(0),
                                     jnp.zeros((1, 8), jnp.int32)))
-        param_specs = llama_param_specs(shapes)
+        param_specs = llama_param_specs(
+            shapes, tp_axis="tp" if n_tp > 1 else None,
+            ep_axis="ep" if n_ep > 1 else None)
         opt_state_specs = F.optax_state_specs(opt, shapes, param_specs)
     else:
         param_specs = opt_state_specs = None
@@ -154,7 +171,7 @@ def main():
         return {"params": base, "opt": opt.init(base)}
 
     state_specs = None
-    if n_tp > 1:
+    if n_model > 1:
         state_specs = {"params": param_specs, "opt": opt_state_specs}
     state = F.rank_major_init(init_state, mesh, specs=state_specs)
     params, opt_state = state["params"], state["opt"]
@@ -202,7 +219,7 @@ def main():
     result = {
         "model": args.model, "params": n_params,
         "optimizer": args.dist_optimizer,
-        "mesh": f"{n_dp}dp x {n_tp}tp x {n_sp}sp",
+        "mesh": f"{n_dp}dp x {n_tp}tp x {n_ep}ep x {n_sp}sp",
         "attn": cfg.attn_mode + "/" + cfg.attn_impl,
         "remat": cfg.remat, "scan_layers": cfg.scan_layers,
         "tokens_per_sec": round(tokens_per_sec, 1),

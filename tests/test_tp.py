@@ -178,3 +178,18 @@ def test_optax_state_specs_structure():
     assert adam_state.mu == specs
     assert adam_state.nu == specs
     assert adam_state.count == P("bf")
+
+
+def test_optax_state_specs_factored_optimizer():
+    """Factored optimizers (adafactor) keep param-structured subtrees
+    with rank-reduced leaves; those must fall back to P('bf') instead of
+    inheriting a model-parallel spec longer than the leaf's rank."""
+    params = {"w": jnp.zeros((8, 16))}
+    specs = {"w": P("bf", None, "tp")}
+    out = F.optax_state_specs(optax.adafactor(1e-3), params, specs)
+    flat = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, P))[0]
+    # every emitted spec is either the param spec (for same-shape leaves)
+    # or the rank-only default — never a 3-axis spec on a 1D leaf
+    assert all(s in (P("bf", None, "tp"), P("bf")) for s in flat)
+    assert P("bf") in flat  # the factored rows/cols fell back
