@@ -16,7 +16,12 @@ from bluefog_tpu.models.resnet import (
     ResNet101,
     ResNet152,
 )
-from bluefog_tpu.models.llama import Llama, LlamaConfig
+from bluefog_tpu.models.llama import (
+    Llama,
+    LlamaConfig,
+    llama_param_specs,
+    llama_pp_loss_fn,
+)
 from bluefog_tpu.models.vit import ViT, ViTConfig, ViT_B16, ViT_S16
 
 __all__ = [
@@ -34,4 +39,6 @@ __all__ = [
     "ResNet152",
     "Llama",
     "LlamaConfig",
+    "llama_param_specs",
+    "llama_pp_loss_fn",
 ]

@@ -142,9 +142,18 @@ class LlamaConfig:
     @staticmethod
     def tiny(**overrides) -> "LlamaConfig":
         """Test-scale config."""
-        return LlamaConfig(
-            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
-            hidden_dim=128, max_seq_len=256, **overrides)
+        base = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, hidden_dim=128, max_seq_len=256)
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+
+def _remat_policies():
+    return {
+        "none": None,
+        "dots": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        "everything": jax.checkpoint_policies.nothing_saveable,
+    }
 
 
 class RMSNorm(nn.Module):
@@ -335,7 +344,11 @@ class MoEFeedForward(nn.Module):
         counts = jnp.zeros((E,), jnp.int32)
         for _ in range(cfg.moe_top_k):
             idx = jnp.argmax(masked, axis=-1)                   # [s]
-            gate = jnp.take_along_axis(probs, idx[:, None],
+            # gate from MASKED probs: if the softmax tail underflowed to
+            # exact zero, a later round's argmax re-picks an earlier
+            # expert — reading the unmasked prob would double-count it
+            # with full weight; the masked value is 0 for re-picks.
+            gate = jnp.take_along_axis(masked, idx[:, None],
                                        axis=-1)[:, 0]           # [s]
             onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)    # [s, E]
             # position of each token within its expert's queue, offset by
@@ -434,12 +447,7 @@ class Llama(nn.Module):
             f"{cfg.max_seq_len}")
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
                      param_dtype=jnp.float32, name="tok_embeddings")(tokens)
-        policies = {
-            "none": None,
-            "dots": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-            "everything": jax.checkpoint_policies.nothing_saveable,
-        }
-        policy = policies[cfg.remat_policy]
+        policy = _remat_policies()[cfg.remat_policy]
         if cfg.scan_layers:
             # one compiled block, scanned n_layers times; params get a
             # leading [n_layers] axis under "layers" — trace/compile cost
@@ -474,17 +482,114 @@ class Llama(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
+                     n_micro: int):
+    """Build a next-token cross-entropy ``loss_fn(params, (inputs,
+    targets))`` that runs the decoder stack as a GPipe pipeline over
+    ``pp_axis`` (see ``bluefog_tpu.parallel.pipeline.gpipe``) — pipeline
+    parallelism, a capability past the reference's DP-only scope
+    (SURVEY.md §2.3: PP absent there).
+
+    Requires ``cfg.scan_layers=True``: the scanned parameter layout gives
+    every block leaf a leading ``[n_layers]`` axis, which
+    ``llama_param_specs(pp_axis=...)`` shards over the pipeline axis so
+    each stage holds ``n_layers / n_stages`` layers.  The param TREE is
+    identical to the plain scanned model — checkpoints move freely
+    between pipeline layouts.
+
+    The returned loss is per-shard MASKED: only the last stage's value is
+    the real loss (other stages return 0).  Feed it to
+    ``build_train_step(pp_axis=...)``, which psums the loss over the
+    pipeline axis and reduces gradients for pp-replicated leaves
+    (embeddings / final norm / head).
+
+    Composes with sequence parallelism (``cfg.attn_mode='ring'``): rotary
+    offsets are derived from the sp shard index internally, and each sp
+    shard's partial loss is averaged by the train step's ``sp_axis``
+    reduction.  Batch size must divide by ``n_micro``.
+    """
+    if not cfg.scan_layers:
+        raise ValueError("pipeline parallelism requires scan_layers=True "
+                         "(the stacked-layer param layout is what shards "
+                         "over the pipeline axis)")
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers ({cfg.n_layers}) must divide by "
+                         f"n_stages ({n_stages})")
+
+    from bluefog_tpu.parallel.pipeline import gpipe
+
+    block = Block(cfg)
+    final_norm = RMSNorm(cfg.norm_eps)
+
+    def loss_fn(params, batch):
+        import optax
+
+        inp, tgt = batch  # [B, T_local] int32
+        p = params["params"]
+        b, t = inp.shape
+        if b % n_micro:
+            raise ValueError(f"batch size {b} must divide by n_micro "
+                             f"({n_micro})")
+        # embedding lookup (same math as nn.Embed with dtype=cfg.dtype)
+        emb = p["tok_embeddings"]["embedding"]
+        x = jnp.take(emb.astype(cfg.dtype), inp, axis=0)  # [B, T, D]
+        pos_offset = 0
+        if cfg.attn_mode == "ring":
+            assert cfg.sp_axis is not None, "ring attention needs sp_axis"
+            pos_offset = lax.axis_index(cfg.sp_axis) * t
+        bm = b // n_micro
+        x_micro = x.reshape(n_micro, bm, t, cfg.dim)
+        layer_p = p["layers"]["block"]  # per-shard: leaves [L/S, ...]
+
+        def per_layer(x, lp):
+            return block.apply({"params": lp}, x, pos_offset), None
+
+        body = per_layer
+        if cfg.remat:
+            body = jax.checkpoint(per_layer,
+                                  policy=_remat_policies()[cfg.remat_policy],
+                                  prevent_cse=False)
+
+        def stage_fn(lp, x):
+            y, _ = lax.scan(body, x, lp)
+            return y
+
+        outs = gpipe(stage_fn, layer_p, x_micro, pp_axis, n_stages)
+        h = outs.reshape(b, t, cfg.dim)
+        # final norm + head are pp-replicated params; every stage runs
+        # them (SPMD lockstep — no extra wall-clock) but only the last
+        # stage's loss survives the mask, so their gradients are nonzero
+        # exactly once across the axis and the train step's pp psum
+        # restores the replicated update.
+        h = final_norm.apply({"params": p["norm"]}, h)
+        head_dtype = jnp.float32 if cfg.logits_dot_in_fp32 else cfg.dtype
+        logits = (h.astype(head_dtype)
+                  @ p["output"]["kernel"].astype(head_dtype))
+        logits = logits.astype(jnp.float32)
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, tgt))
+        stage = lax.axis_index(pp_axis)
+        return jnp.where(stage == n_stages - 1, loss, 0.0)
+
+    return loss_fn
+
+
 def llama_param_specs(params_or_shapes, rank_axis: str = "bf",
                       tp_axis: Optional[str] = "tp",
-                      ep_axis: Optional[str] = "ep"):
+                      ep_axis: Optional[str] = "ep",
+                      pp_axis: Optional[str] = None):
     """PartitionSpec tree for rank-major Llama params under model
     parallelism: column-parallel kernels (wq/wk/wv/w1/w3) shard their
     OUTPUT (last) dim over ``tp_axis``, row-parallel kernels (wo/w2)
     their INPUT (second-to-last) dim; MoE expert tensors (under
-    ``moe_ffn``) shard their EXPERT (first) dim over ``ep_axis``, the
-    router and everything else (embeddings, norms, logits head) stay
-    replicated.  Works for both unrolled and scanned layouts (the kernel
-    rank decides where the sharded dim sits).  Feed the result to
+    ``moe_ffn``) shard their EXPERT dim over ``ep_axis``; with
+    ``pp_axis`` (pipeline parallelism — requires the scanned-layer
+    layout) every leaf under the scanned block additionally shards its
+    leading ``[n_layers]`` axis over the pipeline axis, so each stage
+    holds only its own layers.  The router and everything outside the
+    decoder stack (embeddings, final norm, logits head) stay replicated.
+    Works for both unrolled and scanned layouts (the kernel rank decides
+    where the sharded dim sits).  Feed the result to
     ``optim.functional.build_train_step(param_specs=...)``."""
     from jax.sharding import PartitionSpec as P
 
@@ -494,21 +599,26 @@ def llama_param_specs(params_or_shapes, rank_axis: str = "bf",
     def spec_for(path, leaf):
         names = "/".join(
             str(getattr(p, "key", getattr(p, "name", p))) for p in path)
+        tagged = f"/{names}/"
         # leaf shapes come WITHOUT the leading rank axis (pass the tree
         # that model.init returned); the produced specs are for the
         # rank-major global arrays, so the rank axis is prepended here
         nd = len(leaf.shape)
-        if "/moe_ffn/" in f"/{names}/":
-            if ep_axis is None or "/router/" in f"/{names}/" or nd < 3:
-                return P(rank_axis)
-            # [E, in, out] (or [L, E, in, out] scanned): shard E
-            return P(rank_axis, *([None] * (nd - 3)), ep_axis, None, None)
-        if tp_axis is None:
-            return P(rank_axis)
-        if any(f"/{k}/" in f"/{names}/" for k in column) and nd >= 2:
-            return P(rank_axis, *([None] * (nd - 1)), tp_axis)
-        if any(f"/{k}/" in f"/{names}/" for k in row) and nd >= 2:
-            return P(rank_axis, *([None] * (nd - 2)), tp_axis, None)
-        return P(rank_axis)
+        dims = [None] * nd
+        # scanned decoder stack: leading dim is the layer axis
+        if pp_axis is not None and "/layers/" in tagged and nd >= 1:
+            dims[0] = pp_axis
+        if "/moe_ffn/" in tagged:
+            if ep_axis is not None and "/router/" not in tagged and nd >= 3:
+                dims[-3] = ep_axis  # [.., E, in, out]: shard E
+        elif any(f"/{k}/" in tagged for k in column) and nd >= 2:
+            if tp_axis is not None:
+                dims[-1] = tp_axis
+        elif any(f"/{k}/" in tagged for k in row) and nd >= 2:
+            if tp_axis is not None:
+                dims[-2] = tp_axis
+        while dims and dims[-1] is None:  # canonical: no trailing Nones
+            dims.pop()
+        return P(rank_axis, *dims)
 
     return jax.tree_util.tree_map_with_path(spec_for, params_or_shapes)

@@ -115,18 +115,40 @@ def optax_state_specs(optimizer: optax.GradientTransformation,
         param fall back to the default — factored optimizers (adafactor)
         keep param-structured subtrees with rank-reduced leaves, and a
         model-parallel spec longer than the leaf's rank would fail at
-        device_put."""
-        return jax.tree.map(
-            lambda st, ps, spec: spec if tuple(st.shape) == tuple(ps.shape)
-            else default,
-            node, params_shapes, param_specs)
+        device_put.  The fallback is only sound when the param itself is
+        rank-sharded: a factored moment of a MODEL-PARALLEL param (e.g. a
+        tp-sharded kernel's row statistics) would be replicated while the
+        per-shard gradient is sliced, mismatching inside
+        ``optimizer.update`` at trace time — reject that combination up
+        front with a fix-it message instead."""
+
+        def pick(st, ps, spec):
+            if tuple(st.shape) == tuple(ps.shape):
+                return spec
+            model_axes = [ax for el in spec
+                          for ax in (el if isinstance(el, tuple) else (el,))
+                          if ax is not None and ax != axis_name]
+            if model_axes:
+                raise ValueError(
+                    f"optimizer state leaf of shape {tuple(st.shape)} is "
+                    f"shape-reduced relative to its param "
+                    f"{tuple(ps.shape)} whose spec {spec} is model-"
+                    f"parallel over {model_axes} — factored optimizers "
+                    "(e.g. adafactor) do not compose with model-parallel "
+                    "param shardings here; pass an explicit "
+                    "opt_state_specs tree that shards the factored "
+                    "moments to match, or use a non-factored optimizer")
+            return default
+
+        return jax.tree.map(pick, node, params_shapes, param_specs)
 
     def assign(node):
         try:
-            if jax.tree.structure(node) == params_treedef:
-                return match_specs(node)
+            matches = jax.tree.structure(node) == params_treedef
         except Exception:
-            pass
+            matches = False
+        if matches:
+            return match_specs(node)
         if isinstance(node, tuple) and hasattr(node, "_fields"):
             return type(node)(*[assign(c) for c in node])
         if isinstance(node, tuple):
@@ -192,6 +214,7 @@ def build_train_step(
     num_steps_per_communication: int = 1,
     hierarchical_local_size: Optional[int] = None,
     sp_axis: Optional[str] = None,
+    pp_axis: Optional[str] = None,
     batch_specs: Any = None,
     param_specs: Any = None,
     opt_state_specs: Any = None,
@@ -247,6 +270,11 @@ def build_train_step(
         raise ValueError(
             "hierarchical_local_size is not supported with "
             "comm_mode='push_sum' (flat rank-level push-sum only)")
+    if pp_axis is not None and param_specs is None:
+        raise ValueError(
+            "pp_axis requires param_specs: the spec tree is what tells "
+            "pipeline-sharded leaves (layer stacks, NOT reduced over pp) "
+            "apart from pp-replicated ones (embeddings/head, psum'd)")
     if compress is not None:
         if compress != "int8":
             raise ValueError(f"unknown compress mode {compress!r}")
@@ -324,6 +352,26 @@ def build_train_step(
             # saw a different sequence slice, so reduce both.
             grads = lax.pmean(grads, sp_axis)
             loss = lax.pmean(loss, sp_axis)
+        if pp_axis is not None:
+            # Pipeline parallelism (llama_pp_loss_fn / gpipe): the loss is
+            # masked to the last stage, so a SUM over the axis recovers it
+            # everywhere.  Leaves sharded over pp (the layer stacks) got
+            # exact stage-local gradients through the reversed ppermutes —
+            # no reduction; pp-replicated leaves (embedding/head) got their
+            # gradient on exactly one stage and zeros elsewhere — psum
+            # restores the replicated update.
+            loss = lax.psum(loss, pp_axis)
+
+            def _pp_reduce(g, spec):
+                names = set()
+                for el in spec:
+                    if isinstance(el, tuple):
+                        names.update(el)
+                    elif el is not None:
+                        names.add(el)
+                return g if pp_axis in names else lax.psum(g, pp_axis)
+
+            grads = jax.tree.map(_pp_reduce, grads, param_specs)
         if comm_mode == "gradient_allreduce":
             grads = jax.tree.map(
                 lambda g: C.allreduce(g, axis_name, average=True), grads)

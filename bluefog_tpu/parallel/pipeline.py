@@ -1,0 +1,91 @@
+"""Pipeline parallelism over a mesh axis — GPipe on the ICI torus.
+
+The reference framework is data-parallel only (SURVEY.md §2.3: PP marked
+absent); this module is a capability past it, built the TPU way rather
+than the torch way:
+
+* The pipeline is ONE jitted SPMD program.  Stages are shards of a mesh
+  axis (``pp``); activations move stage-to-stage with a single
+  ``lax.ppermute`` shift per tick — a nearest-neighbor ICI hop, the
+  cheapest collective on the torus.
+* Microbatches stream through a ``lax.scan`` over ``M + S - 1`` ticks
+  (GPipe schedule).  There is no hand-written backward schedule: JAX
+  differentiates the scan, and the transpose of a ``ppermute`` is the
+  reverse ``ppermute`` — the backward pipeline falls out of autodiff,
+  running the same schedule in reverse (the 1F1B interleaving the
+  reference ecosystems hand-schedule is here left to XLA's latency
+  hiding; the bubble fraction is the standard ``(S-1)/(M+S-1)``).
+* Layer parameters live stage-local: with a scanned-layer model
+  (``scan_layers=True``) the leading ``[n_layers]`` axis of every block
+  leaf is sharded over ``pp``, so each stage holds ``n_layers/S`` layers
+  and NO parameter ever moves — only activations do.
+
+Under ``jax.grad`` each stage's layer gradients are exact without any
+cross-stage reduction (cotangents arrive through the reversed permutes);
+parameters replicated over ``pp`` (embeddings, the head) need one
+``psum`` over the axis, which :func:`bluefog_tpu.optim.functional.
+build_train_step` applies for every leaf whose PartitionSpec does not
+mention the pipeline axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["gpipe"]
+
+
+def gpipe(stage_fn: Callable, stage_params, x_micro: jax.Array,
+          pp_axis: str, n_stages: int) -> jax.Array:
+    """Run ``stage_fn`` as a GPipe pipeline over ``pp_axis``.
+
+    Must be called inside ``shard_map`` with ``pp_axis`` bound.
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` with ``y.shape == x.shape`` —
+        this stage's slice of the network (e.g. a ``lax.scan`` over its
+        local decoder layers).
+      stage_params: the stage-local parameter pytree (already sharded:
+        each pp shard passes its own slice).
+      x_micro: ``[M, ...]`` microbatched activations entering stage 0.
+        Every shard passes an identically-shaped array; only stage 0's
+        values are consumed (others may pass the same replicated array).
+      pp_axis: mesh axis name the stages live on.
+      n_stages: static size of that axis.
+
+    Returns:
+      ``[M, ...]`` outputs of the LAST stage.  Only the last stage's
+      values are meaningful; other stages return whatever streamed
+      through them — mask downstream (e.g. keep only the loss term of
+      stage ``n_stages - 1``).
+    """
+    n_micro = x_micro.shape[0]
+    stage = lax.axis_index(pp_axis)
+    shift = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (clamped re-reads past M are never
+        # written to outputs, so they carry no gradient)
+        inject = lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        x_in = jnp.where(stage == 0, inject, state)
+        y = stage_fn(stage_params, x_in)
+        # microbatch m exits the last stage at tick m + S - 1
+        out_idx = t - (n_stages - 1)
+        idx = jnp.clip(out_idx, 0, n_micro - 1)
+        cur = lax.dynamic_index_in_dim(outputs, idx, 0, keepdims=False)
+        write = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, y, cur), idx, 0)
+        state = lax.ppermute(y, pp_axis, shift)
+        return (state, outputs), None
+
+    init = (jnp.zeros_like(x_micro[0]), jnp.zeros_like(x_micro))
+    (_, outputs), _ = lax.scan(
+        tick, init, jnp.arange(n_micro + n_stages - 1))
+    return outputs

@@ -7,7 +7,9 @@ optional sequence parallelism (ring attention) and Pallas flash attention.
 
   --model tiny|200m|1b|8b   (8b needs a pod slice; 200m fits one v5e chip)
   --dist-optimizer neighbor_allreduce|dynamic|horovod|local
-  --sp N                    sequence-parallel ways (mesh becomes dp x sp)
+  --sp N                    sequence-parallel ways (ring attention)
+  --tp N / --ep N / --pp N  tensor- / expert- / pipeline-parallel ways
+                            (mesh becomes dp x tp|ep x pp x sp)
 """
 
 import argparse
@@ -46,6 +48,11 @@ parser.add_argument("--experts", type=int, default=0,
                     help="mixture-of-experts FFN with this many experts")
 parser.add_argument("--ep", type=int, default=1,
                     help="expert-parallel ways (needs --experts)")
+parser.add_argument("--pp", type=int, default=1,
+                    help="pipeline-parallel stages (GPipe over a pp mesh "
+                    "axis; forces --scan-layers)")
+parser.add_argument("--microbatches", type=int, default=0,
+                    help="pipeline microbatches (default 2*pp)")
 parser.add_argument("--attn-impl", default="xla", choices=["xla", "flash"])
 parser.add_argument("--scan-layers", action="store_true",
                     help="nn.scan the decoder stack (O(1) compile in depth)")
@@ -63,7 +70,8 @@ args = parser.parse_args()
 
 
 def make_config():
-    base = dict(remat=not args.no_remat, scan_layers=args.scan_layers,
+    base = dict(remat=not args.no_remat,
+                scan_layers=args.scan_layers or args.pp > 1,
                 remat_policy=args.remat_policy,
                 logits_dot_in_fp32=not args.bf16_logits)
     if args.tp > 1:
@@ -93,28 +101,38 @@ def make_config():
 def main():
     devices = jax.devices()
     n_total = len(devices)
-    n_sp, n_tp, n_ep = args.sp, args.tp, args.ep
+    n_sp, n_tp, n_ep, n_pp = args.sp, args.tp, args.ep, args.pp
     assert n_tp == 1 or n_ep == 1, "tp and ep do not compose yet"
     assert n_ep == 1 or args.experts > 0, \
         "--ep > 1 without --experts would replicate the dense model " \
         "across the ep axis (wasted devices); add --experts N"
     n_model = n_tp * n_ep
-    assert n_total % (n_sp * n_model) == 0, (n_total, n_sp, n_tp, n_ep)
+    assert n_total % (n_sp * n_model * n_pp) == 0, \
+        (n_total, n_sp, n_tp, n_ep, n_pp)
     assert args.seq_len % n_sp == 0, (args.seq_len, n_sp)
-    n_dp = n_total // (n_sp * n_model)
+    n_dp = n_total // (n_sp * n_model * n_pp)
+    n_micro = args.microbatches or (2 * n_pp if n_pp > 1 else 1)
+    assert args.batch_size % n_micro == 0, (args.batch_size, n_micro)
     model_axis = "ep" if n_ep > 1 else "tp"
-    mesh = Mesh(np.array(devices).reshape(n_dp, n_model, n_sp),
-                ("bf", model_axis, "sp"))
+    mesh = Mesh(np.array(devices).reshape(n_dp, n_model, n_pp, n_sp),
+                ("bf", model_axis, "pp", "sp"))
     cfg = make_config()
+    assert cfg.n_layers % n_pp == 0, (cfg.n_layers, n_pp)
     model = models.Llama(cfg)
     t_local = args.seq_len // n_sp
 
-    def loss_fn(params, batch):
-        inp, tgt = batch
-        offset = jax.lax.axis_index("sp") * t_local if n_sp > 1 else 0
-        logits = model.apply(params, inp, pos_offset=offset)
-        return jnp.mean(
-            optax.softmax_cross_entropy_with_integer_labels(logits, tgt))
+    if n_pp > 1:
+        from bluefog_tpu.models.llama import llama_pp_loss_fn
+
+        loss_fn = llama_pp_loss_fn(cfg, pp_axis="pp", n_stages=n_pp,
+                                   n_micro=n_micro)
+    else:
+        def loss_fn(params, batch):
+            inp, tgt = batch
+            offset = jax.lax.axis_index("sp") * t_local if n_sp > 1 else 0
+            logits = model.apply(params, inp, pos_offset=offset)
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(logits, tgt))
 
     topo_kwargs, comm_mode = {}, "none"
     if n_dp > 1:
@@ -137,7 +155,7 @@ def main():
                               "attn_impl": "xla", "sp_axis": None,
                               "tp_axis": None, "tp_size": 1,
                               "ep_axis": None, "ep_size": 1}))
-    if n_model > 1:
+    if n_model > 1 or n_pp > 1:
         from bluefog_tpu.models.llama import llama_param_specs
 
         shapes = jax.eval_shape(
@@ -145,13 +163,15 @@ def main():
                                     jnp.zeros((1, 8), jnp.int32)))
         param_specs = llama_param_specs(
             shapes, tp_axis="tp" if n_tp > 1 else None,
-            ep_axis="ep" if n_ep > 1 else None)
+            ep_axis="ep" if n_ep > 1 else None,
+            pp_axis="pp" if n_pp > 1 else None)
         opt_state_specs = F.optax_state_specs(opt, shapes, param_specs)
     else:
         param_specs = opt_state_specs = None
     step_fn = F.build_train_step(
         loss_fn, opt, mesh, comm_mode=comm_mode,
-        sp_axis="sp" if n_sp > 1 else None, batch_specs=batch_specs,
+        sp_axis="sp" if n_sp > 1 else None,
+        pp_axis="pp" if n_pp > 1 else None, batch_specs=batch_specs,
         param_specs=param_specs, opt_state_specs=opt_state_specs,
         **topo_kwargs)
 
@@ -171,7 +191,7 @@ def main():
         return {"params": base, "opt": opt.init(base)}
 
     state_specs = None
-    if n_model > 1:
+    if param_specs is not None:
         state_specs = {"params": param_specs, "opt": opt_state_specs}
     state = F.rank_major_init(init_state, mesh, specs=state_specs)
     params, opt_state = state["params"], state["opt"]
@@ -211,15 +231,22 @@ def main():
     step_tokens = n_dp * args.batch_size * args.seq_len
     # 6*N per token over MATMUL params (the input embedding table is a
     # gather, not a matmul — excluded; the output head is a real matmul —
-    # included in n_params) + causal attention 6*L*T*d.
+    # included in n_params) + causal attention 6*L*T*d.  For MoE, each
+    # token executes only ~top_k of the n_experts expert FFNs, so count
+    # the ACTIVATED expert params (standard MoE accounting; capacity
+    # drops make this a slight overcount, i.e. MFU is conservative).
     matmul_params = n_params - cfg.vocab_size * cfg.dim
+    if cfg.n_experts:
+        expert_params = (cfg.n_layers * cfg.n_experts * 3
+                         * cfg.dim * cfg.ffn_dim)
+        matmul_params -= expert_params * (1 - cfg.moe_top_k / cfg.n_experts)
     model_flops_per_step = (6.0 * matmul_params * step_tokens
                             + 6.0 * cfg.n_layers * args.seq_len * cfg.dim
                             * step_tokens)
     result = {
         "model": args.model, "params": n_params,
         "optimizer": args.dist_optimizer,
-        "mesh": f"{n_dp}dp x {n_tp}tp x {n_ep}ep x {n_sp}sp",
+        "mesh": f"{n_dp}dp x {n_tp}tp x {n_ep}ep x {n_pp}pp x {n_sp}sp",
         "attn": cfg.attn_mode + "/" + cfg.attn_impl,
         "remat": cfg.remat, "scan_layers": cfg.scan_layers,
         "tokens_per_sec": round(tokens_per_sec, 1),

@@ -96,7 +96,7 @@ def test_moe_param_tree_matches_dense_shapes():
     assert layer["router"]["kernel"].shape == (cfg.dim, 4)
     specs = llama_param_specs(v, tp_axis=None, ep_axis="ep")
     sl = specs["params"]["layer_0"]["moe_ffn"]
-    assert sl["w1"] == P("bf", "ep", None, None)
+    assert sl["w1"] == P("bf", "ep")  # canonical: trailing Nones stripped
     assert sl["router"]["kernel"] == P("bf")
 
 

@@ -1,0 +1,152 @@
+"""Pipeline parallelism (capability beyond the reference — SURVEY.md
+§2.3 lists PP as absent there).
+
+The contract under test: GPipe over a ``pp`` mesh axis
+(``parallel.pipeline.gpipe`` + ``models.llama_pp_loss_fn``) is a LAYOUT,
+not a different model — losses and one-step parameter updates must match
+the unsharded scanned Llama exactly (up to f32 roundoff), including the
+pp-replicated leaves (embedding, final norm, head) whose gradients ride
+the train step's pipeline psum.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bluefog_tpu import models
+from bluefog_tpu.models.llama import llama_param_specs, llama_pp_loss_fn
+from bluefog_tpu.optim import functional as F
+from bluefog_tpu.topology import RingGraph, uniform_topology_spec
+
+B, T, L = 4, 16, 4
+
+
+def _cfg():
+    return models.LlamaConfig.tiny(dtype=jnp.float32, n_layers=L,
+                                   scan_layers=True)
+
+
+def _data(n_bf, seed=0):
+    rng = np.random.RandomState(seed)
+    raw = rng.randint(0, 256, size=(n_bf, B, T + 1)).astype(np.int32)
+    return raw[:, :, :-1], raw[:, :, 1:]
+
+
+def _plain_loss(model, variables, inp, tgt):
+    logits = model.apply(variables, inp)
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, tgt))
+
+
+def _build(mesh, n_bf, n_pp, n_micro, comm_mode="none", **kw):
+    cfg = _cfg()
+    model = models.Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((B, 8), jnp.int32))
+    specs = llama_param_specs(variables, tp_axis=None, ep_axis=None,
+                              pp_axis="pp")
+    opt = optax.sgd(0.1)
+    loss_fn = llama_pp_loss_fn(cfg, pp_axis="pp", n_stages=n_pp,
+                               n_micro=n_micro)
+    step = F.build_train_step(
+        loss_fn, opt, mesh, comm_mode=comm_mode, pp_axis="pp",
+        batch_specs=P("bf"), param_specs=specs,
+        opt_state_specs=F.optax_state_specs(opt, variables, specs), **kw)
+    params = F.rank_major(variables, mesh, specs=specs)
+    opt_state = F.rank_major(
+        opt.init(variables), mesh,
+        specs=F.optax_state_specs(opt, variables, specs))
+    return cfg, model, variables, opt, step, params, opt_state
+
+
+@pytest.mark.parametrize("n_micro", [1, 2, 4])
+def test_pp_loss_matches_unsharded(n_micro):
+    n_bf, n_pp = 2, 4
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(n_bf, n_pp),
+                ("bf", "pp"))
+    cfg, model, variables, opt, step, params, opt_state = _build(
+        mesh, n_bf, n_pp, n_micro)
+    inp, tgt = _data(n_bf)
+    batch = (jax.device_put(inp, NamedSharding(mesh, P("bf"))),
+             jax.device_put(tgt, NamedSharding(mesh, P("bf"))))
+    _, _, loss = step(params, opt_state, batch, jnp.int32(0))
+    loss = np.asarray(loss)
+    for r in range(n_bf):
+        ref = float(_plain_loss(model, variables, inp[r], tgt[r]))
+        np.testing.assert_allclose(loss[r], ref, rtol=1e-5, atol=1e-5)
+
+
+def test_pp_one_step_update_matches_unsharded():
+    """One SGD step under pp == one SGD step of the plain scanned model,
+    leaf by leaf — layer stacks (pp-sharded) AND embeddings/head
+    (pp-replicated, exercised by the pipeline-axis psum)."""
+    n_bf, n_pp = 2, 4
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(n_bf, n_pp),
+                ("bf", "pp"))
+    cfg, model, variables, opt, step, params, opt_state = _build(
+        mesh, n_bf, n_pp, n_micro=2)
+    inp, tgt = _data(n_bf)
+    batch = (jax.device_put(inp, NamedSharding(mesh, P("bf"))),
+             jax.device_put(tgt, NamedSharding(mesh, P("bf"))))
+    new_params, _, _ = step(params, opt_state, batch, jnp.int32(0))
+
+    for r in range(n_bf):
+        grads = jax.grad(
+            lambda v: _plain_loss(model, v, inp[r], tgt[r]))(variables)
+        expect = jax.tree.map(lambda p, g: p - 0.1 * g, variables, grads)
+        got_r = jax.tree.map(lambda l: np.asarray(l[r]), new_params)
+        flat_e, _ = jax.tree_util.tree_flatten_with_path(expect)
+        flat_g = jax.tree.leaves(got_r)
+        for (path, e), g in zip(flat_e, flat_g):
+            np.testing.assert_allclose(
+                g, np.asarray(e), rtol=2e-5, atol=2e-5,
+                err_msg=jax.tree_util.keystr(path))
+
+
+def test_pp_composes_with_decentralized_combine():
+    """dp x pp ATC run == dp-only ATC run: the pipeline changes the
+    layout of the model, not the decentralized algorithm."""
+    n_bf, n_pp = 4, 2
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(n_bf, n_pp),
+                ("bf", "pp"))
+    topo = uniform_topology_spec(RingGraph(n_bf))
+    cfg, model, variables, opt, step, params, opt_state = _build(
+        mesh, n_bf, n_pp, n_micro=2, comm_mode="atc", topology=topo)
+    inp, tgt = _data(n_bf)
+    batch = (jax.device_put(inp, NamedSharding(mesh, P("bf"))),
+             jax.device_put(tgt, NamedSharding(mesh, P("bf"))))
+    for s in range(2):
+        params, opt_state, _ = step(params, opt_state, batch, jnp.int32(s))
+
+    # dp-only reference on a flat 4-device mesh
+    mesh_dp = Mesh(np.array(jax.devices()[:n_bf]), ("bf",))
+    step_dp = F.build_train_step(
+        lambda v, b: _plain_loss(model, v, b[0], b[1]), opt, mesh_dp,
+        comm_mode="atc", topology=topo)
+    params_dp = F.rank_major(variables, mesh_dp)
+    opt_dp = F.rank_major(opt.init(variables), mesh_dp)
+    batch_dp = (jax.device_put(inp, NamedSharding(mesh_dp, P("bf"))),
+                jax.device_put(tgt, NamedSharding(mesh_dp, P("bf"))))
+    for s in range(2):
+        params_dp, opt_dp, _ = step_dp(params_dp, opt_dp, batch_dp,
+                                       jnp.int32(s))
+
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(params)
+    flat_b = jax.tree.leaves(params_dp)
+    for (path, a), b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-5, atol=3e-5,
+            err_msg=jax.tree_util.keystr(path))
+
+
+def test_pp_requires_scan_layers_and_divisibility():
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32, n_layers=L)
+    with pytest.raises(ValueError, match="scan_layers"):
+        llama_pp_loss_fn(cfg, pp_axis="pp", n_stages=2, n_micro=2)
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32, n_layers=3,
+                                  scan_layers=True)
+    with pytest.raises(ValueError, match="divide"):
+        llama_pp_loss_fn(cfg, pp_axis="pp", n_stages=2, n_micro=2)

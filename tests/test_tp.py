@@ -124,7 +124,7 @@ def test_tp_param_specs_shapes(mesh):
     wo = next(v for k, v in by_name.items() if "wo" in k)
     norm = next(v for k, v in by_name.items() if "attention_norm" in k)
     assert wq == P("bf", None, "tp")
-    assert wo == P("bf", "tp", None)
+    assert wo == P("bf", "tp")  # canonical: trailing Nones stripped
     assert norm == P("bf")
 
 
@@ -182,14 +182,20 @@ def test_optax_state_specs_structure():
 
 def test_optax_state_specs_factored_optimizer():
     """Factored optimizers (adafactor) keep param-structured subtrees
-    with rank-reduced leaves; those must fall back to P('bf') instead of
-    inheriting a model-parallel spec longer than the leaf's rank."""
+    with rank-reduced leaves.  Under rank-only (dp) sharding those fall
+    back to P('bf'); under a MODEL-parallel param spec the factored
+    moments cannot be derived automatically (a replicated moment would
+    mismatch the sliced per-shard gradient inside optimizer.update), so
+    the combination is rejected up front with a fix-it error."""
     params = {"w": jnp.zeros((8, 16))}
-    specs = {"w": P("bf", None, "tp")}
-    out = F.optax_state_specs(optax.adafactor(1e-3), params, specs)
+
+    # dp-only: rank-reduced leaves fall back to the rank spec
+    out = F.optax_state_specs(optax.adafactor(1e-3), params, {"w": P("bf")})
     flat = jax.tree_util.tree_flatten(
         out, is_leaf=lambda x: isinstance(x, P))[0]
-    # every emitted spec is either the param spec (for same-shape leaves)
-    # or the rank-only default — never a 3-axis spec on a 1D leaf
-    assert all(s in (P("bf", None, "tp"), P("bf")) for s in flat)
-    assert P("bf") in flat  # the factored rows/cols fell back
+    assert all(s == P("bf") for s in flat)
+
+    # model-parallel: clear error instead of a trace-time shape crash
+    with pytest.raises(ValueError, match="factored"):
+        F.optax_state_specs(optax.adafactor(1e-3), params,
+                            {"w": P("bf", None, "tp")})
