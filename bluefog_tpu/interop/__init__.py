@@ -16,3 +16,7 @@ from bluefog_tpu.interop.torch_adapter import (  # noqa: F401
     broadcast_parameters,
     neighbor_allreduce,
 )
+from bluefog_tpu.interop.hf_llama import (  # noqa: F401
+    llama_config_from_hf,
+    llama_params_from_hf,
+)

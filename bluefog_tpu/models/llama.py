@@ -74,6 +74,21 @@ class LlamaConfig:
     ep_axis: Optional[str] = None
     ep_size: int = 1
     capacity_factor: float = 1.25
+    # Routing group size: tokens are routed within fixed-size groups with
+    # per-group expert capacity (flaxformer/MaxText-style), keeping the
+    # dispatch/combine tensors O(s * group) instead of O(s^2) — without
+    # grouping, capacity grows with s and the [s, E, cap] one-hots blow
+    # up at benchmark sequence lengths.  0 = one group over all tokens
+    # (exact original behavior); otherwise the effective group is the
+    # largest divisor of the token count <= this value.
+    moe_group_size: int = 4096
+    # Weight of the Switch-style load-balance auxiliary loss.  The loss
+    # is always sown under "intermediates" (scan included); the shipped
+    # loss builders (llama_benchmark, llama_pp_loss_fn) ADD
+    # moe_aux_weight * total_aux to the objective when this is > 0 —
+    # without it routers can collapse onto few experts and capacity
+    # drops silently bypass the FFN.
+    moe_aux_weight: float = 0.0
     remat: bool = False
     # Compile the decoder stack as ONE nn.scan'd block instead of L unrolled
     # copies: params gain a leading [n_layers] axis, trace/compile time goes
@@ -301,12 +316,20 @@ class MoEFeedForward(nn.Module):
     (tokens are replicated over ``ep_axis``), dispatch/combine are static
     einsums against a capacity-bounded one-hot tensor (no dynamic shapes,
     no host round trips), each shard evaluates only its LOCAL experts as
-    one batched ``[local_E, capacity, d]`` einsum on the MXU, and the
+    one batched ``[local_E, slots, d]`` einsum on the MXU, and the
     shards' partial outputs merge with ONE psum (through the Megatron-
     style g operator; the token stream enters through f so gradients are
     exact — see ``_tp_region_in/_tp_region_out``).  Tokens over an
     expert's capacity are dropped (they ride the residual), the standard
     static-shape MoE contract.
+
+    Routing is GROUPED (``cfg.moe_group_size``): tokens route within
+    fixed-size groups with per-group capacity, so the one-hot
+    dispatch/combine tensors are ``[g, G, E, cap]`` with
+    ``g*G*E*cap = capacity_factor*top_k*s*G`` elements — LINEAR in the
+    token count ``s`` for fixed ``G`` (an ungrouped capacity grows with
+    ``s`` and the tensors are O(s^2), which OOMs at real sequence
+    lengths).
     """
 
     cfg: LlamaConfig
@@ -319,6 +342,14 @@ class MoEFeedForward(nn.Module):
         local_E = E // cfg.ep_size
         ep = cfg.ep_axis is not None and cfg.ep_size > 1
         s = b * t
+        # effective group: the largest divisor of s <= moe_group_size
+        # (static Python arithmetic — shapes stay compile-time constants)
+        G = s
+        if 0 < cfg.moe_group_size < s:
+            G = cfg.moe_group_size
+            while s % G:
+                G -= 1
+        g = s // G
         # Two independent paths enter the expert region, each wrapped in
         # its OWN f operator (identity fwd / psum bwd) so every backward
         # contribution is summed over ep exactly once: the token stream
@@ -329,54 +360,57 @@ class MoEFeedForward(nn.Module):
         flat_raw = x.reshape(s, d)
         if ep:
             x = _tp_region_in(x, cfg.ep_axis)
-        flat = x.reshape(s, d)
-        cap = max(1, int(cfg.capacity_factor * s * cfg.moe_top_k / E))
+        flat = x.reshape(g, G, d)
+        cap = max(1, int(cfg.capacity_factor * G * cfg.moe_top_k / E))
 
         logits_raw = nn.Dense(E, use_bias=False, dtype=jnp.float32,
                               param_dtype=jnp.float32, name="router")(
                                   flat_raw.astype(jnp.float32))
         logits = _tp_region_in(logits_raw, cfg.ep_axis) if ep else logits_raw
-        probs = jax.nn.softmax(logits, axis=-1)  # [s, E]
+        probs = jax.nn.softmax(logits, axis=-1).reshape(g, G, E)
 
         # top-k selection: k rounds of argmax with masking (k is tiny)
         masked = probs
-        combine = jnp.zeros((s, E, cap), jnp.float32)
-        counts = jnp.zeros((E,), jnp.int32)
+        combine = jnp.zeros((g, G, E, cap), jnp.float32)
+        counts = jnp.zeros((g, E), jnp.int32)
         for _ in range(cfg.moe_top_k):
-            idx = jnp.argmax(masked, axis=-1)                   # [s]
+            idx = jnp.argmax(masked, axis=-1)                   # [g, G]
             # gate from MASKED probs: if the softmax tail underflowed to
             # exact zero, a later round's argmax re-picks an earlier
             # expert — reading the unmasked prob would double-count it
             # with full weight; the masked value is 0 for re-picks.
-            gate = jnp.take_along_axis(masked, idx[:, None],
-                                       axis=-1)[:, 0]           # [s]
-            onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)    # [s, E]
-            # position of each token within its expert's queue, offset by
-            # what previous rounds already enqueued
-            pos = jnp.cumsum(onehot, axis=0) - onehot + counts[None, :]
-            pos_tok = jnp.sum(pos * onehot, axis=-1)            # [s]
+            gate = jnp.take_along_axis(masked, idx[..., None],
+                                       axis=-1)[..., 0]         # [g, G]
+            onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)    # [g, G, E]
+            # position of each token within its expert's per-group queue,
+            # offset by what previous rounds already enqueued
+            pos = (jnp.cumsum(onehot, axis=1) - onehot
+                   + counts[:, None, :])
+            pos_tok = jnp.sum(pos * onehot, axis=-1)            # [g, G]
             keep = pos_tok < cap
             combine = combine + (
-                gate[:, None, None]
-                * jax.nn.one_hot(idx, E)[:, :, None]
-                * jax.nn.one_hot(pos_tok, cap)[:, None, :]
-                * keep[:, None, None])
-            counts = counts + jnp.sum(onehot * keep[:, None].astype(
-                jnp.int32), axis=0)
+                gate[..., None, None]
+                * jax.nn.one_hot(idx, E)[..., None]
+                * jax.nn.one_hot(pos_tok, cap)[..., None, :]
+                * keep[..., None, None])
+            counts = counts + jnp.sum(
+                onehot * keep[..., None].astype(jnp.int32), axis=1)
             masked = masked * (1.0 - onehot.astype(masked.dtype))
 
-        dispatch = (combine > 0.0).astype(cfg.dtype)  # [s, E, cap]
+        dispatch = (combine > 0.0).astype(cfg.dtype)  # [g, G, E, cap]
         # my shard's expert slice
         if ep:
             e_lo = jax.lax.axis_index(cfg.ep_axis) * local_E
         else:
             e_lo = 0
-        disp_local = lax.dynamic_slice_in_dim(dispatch, e_lo, local_E, 1)
+        disp_local = lax.dynamic_slice_in_dim(dispatch, e_lo, local_E, 2)
         comb_local = lax.dynamic_slice_in_dim(
-            combine.astype(cfg.dtype), e_lo, local_E, 1)
+            combine.astype(cfg.dtype), e_lo, local_E, 2)
 
-        expert_in = jnp.einsum("sec,sd->ecd", disp_local,
+        # gather each expert's slots across all groups into one MXU batch
+        expert_in = jnp.einsum("gsec,gsd->egcd", disp_local,
                                flat.astype(cfg.dtype))
+        expert_in = expert_in.reshape(local_E, g * cap, d)
         h = cfg.ffn_dim
         w1 = self.param("w1", nn.initializers.lecun_normal(
             in_axis=-2, out_axis=-1), (local_E, d, h), jnp.float32)
@@ -388,26 +422,26 @@ class MoEFeedForward(nn.Module):
         up_h = jnp.einsum("ecd,edh->ech", expert_in, w3.astype(cfg.dtype))
         expert_out = jnp.einsum("ech,ehd->ecd", nn.silu(gate_h) * up_h,
                                 w2.astype(cfg.dtype))
-        out = jnp.einsum("ecd,sec->sd", expert_out, comb_local)
+        expert_out = expert_out.reshape(local_E, g, cap, d)
+        out = jnp.einsum("egcd,gsec->gsd", expert_out, comb_local)
         if ep:
             out = _tp_region_out(out, cfg.ep_axis)
         # load-balancing auxiliary loss (Switch Transformer eq. 4) —
-        # exposed via sow; trainers may add cfg-weighted aux to the loss.
-        # (Not sown under scan_layers: the scanned block would need an
-        # intermediates axis declaration for a diagnostics-only value.)
-        if not cfg.scan_layers:
-            # computed from the UNWRAPPED logits: the aux term is a
-            # replicated computation outside the expert region, so adding
-            # it to the loss gives the unsharded router gradient exactly
-            # (through the f-wrapped logits its backward psum would scale
-            # the aux contribution by ep_size)
-            probs_raw = jax.nn.softmax(logits_raw, axis=-1)
-            frac_tokens = jnp.mean(
-                jax.nn.one_hot(jnp.argmax(probs_raw, -1), E,
-                               dtype=jnp.float32), axis=0)
-            frac_probs = jnp.mean(probs_raw, axis=0)
-            self.sow("intermediates", "moe_aux_loss",
-                     E * jnp.sum(frac_tokens * frac_probs))
+        # always sown (the scanned stack declares an intermediates axis);
+        # trainers add cfg.moe_aux_weight * total to the objective (the
+        # shipped loss builders do — see llama_pp_loss_fn and
+        # examples/llama_benchmark.py).  Computed from the UNWRAPPED
+        # logits: the aux term is a replicated computation outside the
+        # expert region, so adding it to the loss gives the unsharded
+        # router gradient exactly (through the f-wrapped logits its
+        # backward psum would scale the aux contribution by ep_size).
+        probs_all = jax.nn.softmax(logits_raw, axis=-1)
+        frac_tokens = jnp.mean(
+            jax.nn.one_hot(jnp.argmax(probs_all, -1), E,
+                           dtype=jnp.float32), axis=0)
+        frac_probs = jnp.mean(probs_all, axis=0)
+        self.sow("intermediates", "moe_aux_loss",
+                 E * jnp.sum(frac_tokens * frac_probs))
         return out.reshape(b, t, d).astype(x.dtype)
 
 
@@ -461,7 +495,7 @@ class Llama(nn.Module):
                                      prevent_cse=False)
             scan_cls = nn.scan(
                 body,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=cfg.n_layers,
@@ -518,8 +552,16 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
 
     from bluefog_tpu.parallel.pipeline import gpipe
 
+    # the exact modules Llama.__call__ uses — applied to param subtrees,
+    # so the pp path cannot diverge from the plain model's math
     block = Block(cfg)
     final_norm = RMSNorm(cfg.norm_eps)
+    embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                     param_dtype=jnp.float32)
+    head_dtype = jnp.float32 if cfg.logits_dot_in_fp32 else cfg.dtype
+    head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=head_dtype,
+                    param_dtype=jnp.float32)
+    want_aux = cfg.n_experts > 0 and cfg.moe_aux_weight > 0.0
 
     def loss_fn(params, batch):
         import optax
@@ -530,9 +572,7 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
         if b % n_micro:
             raise ValueError(f"batch size {b} must divide by n_micro "
                              f"({n_micro})")
-        # embedding lookup (same math as nn.Embed with dtype=cfg.dtype)
-        emb = p["tok_embeddings"]["embedding"]
-        x = jnp.take(emb.astype(cfg.dtype), inp, axis=0)  # [B, T, D]
+        x = embed.apply({"params": p["tok_embeddings"]}, inp)  # [B, T, D]
         pos_offset = 0
         if cfg.attn_mode == "ring":
             assert cfg.sp_axis is not None, "ring attention needs sp_axis"
@@ -542,7 +582,13 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
         layer_p = p["layers"]["block"]  # per-shard: leaves [L/S, ...]
 
         def per_layer(x, lp):
-            return block.apply({"params": lp}, x, pos_offset), None
+            if want_aux:
+                y, mut = block.apply({"params": lp}, x, pos_offset,
+                                     mutable=["intermediates"])
+                aux = sum(jnp.sum(v) for v in
+                          jax.tree.leaves(mut["intermediates"]))
+                return y, aux
+            return block.apply({"params": lp}, x, pos_offset), jnp.float32(0)
 
         body = per_layer
         if cfg.remat:
@@ -551,10 +597,11 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
                                   prevent_cse=False)
 
         def stage_fn(lp, x):
-            y, _ = lax.scan(body, x, lp)
-            return y
+            y, aux = lax.scan(body, x, lp)
+            return y, jnp.sum(aux)
 
-        outs = gpipe(stage_fn, layer_p, x_micro, pp_axis, n_stages)
+        outs, aux_sum = gpipe(stage_fn, layer_p, x_micro, pp_axis,
+                              n_stages, with_aux=True)
         h = outs.reshape(b, t, cfg.dim)
         # final norm + head are pp-replicated params; every stage runs
         # them (SPMD lockstep — no extra wall-clock) but only the last
@@ -562,14 +609,20 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
         # exactly once across the axis and the train step's pp psum
         # restores the replicated update.
         h = final_norm.apply({"params": p["norm"]}, h)
-        head_dtype = jnp.float32 if cfg.logits_dot_in_fp32 else cfg.dtype
-        logits = (h.astype(head_dtype)
-                  @ p["output"]["kernel"].astype(head_dtype))
-        logits = logits.astype(jnp.float32)
+        logits = head.apply({"params": p["output"]}, h).astype(jnp.float32)
         loss = jnp.mean(
             optax.softmax_cross_entropy_with_integer_labels(logits, tgt))
         stage = lax.axis_index(pp_axis)
-        return jnp.where(stage == n_stages - 1, loss, 0.0)
+        loss = jnp.where(stage == n_stages - 1, loss, 0.0)
+        if want_aux:
+            # each stage owns its layers' routers, so its aux rides its
+            # OWN loss term (unmasked — the train step's pp psum then
+            # totals CE + every stage's aux).  aux_sum is over the M real
+            # microbatch ticks; /M gives the per-microbatch mean — the
+            # grouped-routing analogue of the unsharded full-batch aux
+            # (identical to it when n_micro == 1).
+            loss = loss + cfg.moe_aux_weight * aux_sum / n_micro
+        return loss
 
     return loss_fn
 

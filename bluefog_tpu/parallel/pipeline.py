@@ -40,7 +40,7 @@ __all__ = ["gpipe"]
 
 
 def gpipe(stage_fn: Callable, stage_params, x_micro: jax.Array,
-          pp_axis: str, n_stages: int) -> jax.Array:
+          pp_axis: str, n_stages: int, with_aux: bool = False):
     """Run ``stage_fn`` as a GPipe pipeline over ``pp_axis``.
 
     Must be called inside ``shard_map`` with ``pp_axis`` bound.
@@ -48,7 +48,9 @@ def gpipe(stage_fn: Callable, stage_params, x_micro: jax.Array,
     Args:
       stage_fn: ``(stage_params, x) -> y`` with ``y.shape == x.shape`` —
         this stage's slice of the network (e.g. a ``lax.scan`` over its
-        local decoder layers).
+        local decoder layers).  With ``with_aux=True`` the signature is
+        ``(stage_params, x) -> (y, aux)`` where ``aux`` is a scalar
+        (e.g. a MoE load-balance term).
       stage_params: the stage-local parameter pytree (already sharded:
         each pp shard passes its own slice).
       x_micro: ``[M, ...]`` microbatched activations entering stage 0.
@@ -56,9 +58,14 @@ def gpipe(stage_fn: Callable, stage_params, x_micro: jax.Array,
         values are consumed (others may pass the same replicated array).
       pp_axis: mesh axis name the stages live on.
       n_stages: static size of that axis.
+      with_aux: accumulate stage_fn's scalar aux over the ticks where
+        this stage is processing a REAL microbatch (bubble/garbage ticks
+        are masked out), returning ``(outputs, aux_sum)`` — caller
+        typically divides by ``M`` for a per-microbatch mean.
 
     Returns:
-      ``[M, ...]`` outputs of the LAST stage.  Only the last stage's
+      ``[M, ...]`` outputs of the LAST stage (plus the stage-local
+      ``aux_sum`` with ``with_aux``).  Only the last stage's output
       values are meaningful; other stages return whatever streamed
       through them — mask downstream (e.g. keep only the loss term of
       stage ``n_stages - 1``).
@@ -68,13 +75,20 @@ def gpipe(stage_fn: Callable, stage_params, x_micro: jax.Array,
     shift = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def tick(carry, t):
-        state, outputs = carry
+        state, outputs, aux_acc = carry
         # stage 0 ingests microbatch t (clamped re-reads past M are never
         # written to outputs, so they carry no gradient)
         inject = lax.dynamic_index_in_dim(
             x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
         x_in = jnp.where(stage == 0, inject, state)
-        y = stage_fn(stage_params, x_in)
+        if with_aux:
+            y, aux = stage_fn(stage_params, x_in)
+            # stage s processes microbatch t - s at tick t; ticks outside
+            # [s, s + M) stream zeros/garbage — exclude their aux
+            valid = jnp.logical_and(t >= stage, t - stage < n_micro)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+        else:
+            y = stage_fn(stage_params, x_in)
         # microbatch m exits the last stage at tick m + S - 1
         out_idx = t - (n_stages - 1)
         idx = jnp.clip(out_idx, 0, n_micro - 1)
@@ -83,9 +97,12 @@ def gpipe(stage_fn: Callable, stage_params, x_micro: jax.Array,
         outputs = lax.dynamic_update_index_in_dim(
             outputs, jnp.where(write, y, cur), idx, 0)
         state = lax.ppermute(y, pp_axis, shift)
-        return (state, outputs), None
+        return (state, outputs, aux_acc), None
 
-    init = (jnp.zeros_like(x_micro[0]), jnp.zeros_like(x_micro))
-    (_, outputs), _ = lax.scan(
+    init = (jnp.zeros_like(x_micro[0]), jnp.zeros_like(x_micro),
+            jnp.float32(0.0))
+    (_, outputs, aux_sum), _ = lax.scan(
         tick, init, jnp.arange(n_micro + n_stages - 1))
+    if with_aux:
+        return outputs, aux_sum
     return outputs

@@ -48,6 +48,8 @@ parser.add_argument("--experts", type=int, default=0,
                     help="mixture-of-experts FFN with this many experts")
 parser.add_argument("--ep", type=int, default=1,
                     help="expert-parallel ways (needs --experts)")
+parser.add_argument("--moe-aux-weight", type=float, default=0.01,
+                    help="Switch load-balance aux loss weight (MoE only)")
 parser.add_argument("--pp", type=int, default=1,
                     help="pipeline-parallel stages (GPipe over a pp mesh "
                     "axis; forces --scan-layers)")
@@ -77,7 +79,8 @@ def make_config():
     if args.tp > 1:
         base.update(tp_axis="tp", tp_size=args.tp)
     if args.experts:
-        base.update(n_experts=args.experts)
+        base.update(n_experts=args.experts,
+                    moe_aux_weight=args.moe_aux_weight)
         if args.ep > 1:
             base.update(ep_axis="ep", ep_size=args.ep)
     if args.sp > 1:
@@ -111,6 +114,8 @@ def main():
         (n_total, n_sp, n_tp, n_ep, n_pp)
     assert args.seq_len % n_sp == 0, (args.seq_len, n_sp)
     n_dp = n_total // (n_sp * n_model * n_pp)
+    assert args.microbatches == 0 or n_pp > 1, \
+        "--microbatches only applies with --pp > 1"
     n_micro = args.microbatches or (2 * n_pp if n_pp > 1 else 1)
     assert args.batch_size % n_micro == 0, (args.batch_size, n_micro)
     model_axis = "ep" if n_ep > 1 else "tp"
@@ -127,12 +132,22 @@ def main():
         loss_fn = llama_pp_loss_fn(cfg, pp_axis="pp", n_stages=n_pp,
                                    n_micro=n_micro)
     else:
+        want_aux = cfg.n_experts > 0 and cfg.moe_aux_weight > 0.0
+
         def loss_fn(params, batch):
             inp, tgt = batch
             offset = jax.lax.axis_index("sp") * t_local if n_sp > 1 else 0
-            logits = model.apply(params, inp, pos_offset=offset)
-            return jnp.mean(
+            aux = 0.0
+            if want_aux:
+                logits, mut = model.apply(params, inp, pos_offset=offset,
+                                          mutable=["intermediates"])
+                aux = sum(jnp.sum(v) for v in
+                          jax.tree.leaves(mut["intermediates"]))
+            else:
+                logits = model.apply(params, inp, pos_offset=offset)
+            ce = jnp.mean(
                 optax.softmax_cross_entropy_with_integer_labels(logits, tgt))
+            return ce + cfg.moe_aux_weight * aux
 
     topo_kwargs, comm_mode = {}, "none"
     if n_dp > 1:

@@ -1,0 +1,112 @@
+"""HuggingFace Llama checkpoint import: converted weights must reproduce
+``transformers``' logits to float32 roundoff — the interop contract for
+users switching to this framework with published weights in hand
+(reference users come from the torch ecosystem; SURVEY.md §2.1 torch
+adapter role).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from bluefog_tpu import models  # noqa: E402
+from bluefog_tpu.interop.hf_llama import (  # noqa: E402
+    llama_config_from_hf,
+    llama_params_from_hf,
+)
+
+B, T = 2, 12
+
+
+def _tiny_hf():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=128, max_position_embeddings=256,
+        rope_theta=500000.0, rms_norm_eps=1e-5, attention_bias=False,
+        mlp_bias=False, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    m = transformers.LlamaForCausalLM(hf_cfg)
+    m = m.float().eval()
+    return hf_cfg, m
+
+
+def _hf_logits(hf_model, tokens_np):
+    with torch.no_grad():
+        out = hf_model(input_ids=torch.from_numpy(tokens_np).long())
+    return out.logits.float().numpy()
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_hf_logits_match(scan_layers):
+    hf_cfg, hf_model = _tiny_hf()
+    cfg = llama_config_from_hf(hf_cfg, dtype=jnp.float32,
+                               scan_layers=scan_layers)
+    params = llama_params_from_hf(hf_model, cfg)
+    model = models.Llama(cfg)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 256, size=(B, T)).astype(np.int32)
+
+    ours = np.asarray(model.apply(params, tokens))
+    theirs = _hf_logits(hf_model, tokens)
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_config_mapping():
+    hf_cfg, _ = _tiny_hf()
+    cfg = llama_config_from_hf(hf_cfg)
+    assert cfg.dim == 64 and cfg.n_layers == 2
+    assert cfg.n_heads == 4 and cfg.n_kv_heads == 2
+    assert cfg.ffn_dim == 128 and cfg.rope_theta == 500000.0
+
+
+def test_hf_unsupported_features_raise():
+    """Features this framework does not implement must fail loudly: a
+    silent pass-through (e.g. Llama-3.1's rope scaling) would convert
+    into a model whose logits quietly diverge from transformers."""
+    hf_cfg, _ = _tiny_hf()
+    hf_cfg.rope_scaling = {"rope_type": "llama3", "factor": 8.0}
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        llama_config_from_hf(hf_cfg)
+    hf_cfg.rope_scaling = None
+    hf_cfg.attention_bias = True
+    with pytest.raises(NotImplementedError, match="attention_bias"):
+        llama_config_from_hf(hf_cfg)
+
+
+def test_hf_import_feeds_parallel_layouts():
+    """The imported tree is the same TREE every parallel layout uses:
+    shard it rank-major with pp specs and take one pipelined step."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import optax
+
+    from bluefog_tpu.models.llama import llama_param_specs, llama_pp_loss_fn
+    from bluefog_tpu.optim import functional as F
+
+    hf_cfg, hf_model = _tiny_hf()
+    cfg = llama_config_from_hf(hf_cfg, dtype=jnp.float32, scan_layers=True)
+    variables = llama_params_from_hf(hf_model, cfg)
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("bf", "pp"))
+    specs = llama_param_specs(variables, tp_axis=None, ep_axis=None,
+                              pp_axis="pp")
+    opt = optax.sgd(0.1)
+    opt_specs = F.optax_state_specs(opt, variables, specs)
+    step = F.build_train_step(
+        llama_pp_loss_fn(cfg, pp_axis="pp", n_stages=2, n_micro=2),
+        opt, mesh, comm_mode="none", pp_axis="pp", batch_specs=P("bf"),
+        param_specs=specs, opt_state_specs=opt_specs, donate=False)
+    params = F.rank_major(variables, mesh, specs=specs)
+    opt_state = F.rank_major(opt.init(variables), mesh, specs=opt_specs)
+    raw = np.random.RandomState(0).randint(
+        0, 256, (2, B, T + 1)).astype(np.int32)
+    sharding = NamedSharding(mesh, P("bf"))
+    batch = (jax.device_put(raw[:, :, :-1], sharding),
+             jax.device_put(raw[:, :, 1:], sharding))
+    _, _, loss = step(params, opt_state, batch, jnp.int32(0))
+    assert np.all(np.isfinite(np.asarray(loss)))

@@ -137,6 +137,41 @@ def test_dynamic_step_program_permute_total_is_schedule_size(mesh):
     assert "conditional" in hlo
 
 
+def test_pipeline_is_one_permute_per_tick(mesh):
+    """The GPipe pipeline's wire cost: activations move stage-to-stage
+    with a single nearest-neighbor collective-permute per tick, inside
+    ONE scan loop (not unrolled) — so the compiled forward contains
+    exactly one permute instruction, and forward+backward exactly two
+    (the reversed permute the autodiff transpose inserts)."""
+    from bluefog_tpu.parallel.pipeline import gpipe
+
+    n_micro = 4
+
+    def fwd(w, x_micro):
+        def stage_fn(w, x):
+            return jnp.tanh(x @ w[0])  # [1,16,16] per-shard slice
+
+        outs = gpipe(stage_fn, w, x_micro, "bf", N)
+        return outs
+
+    def loss(w, x_micro):
+        return jnp.sum(fwd(w, x_micro) ** 2)
+
+    sm_fwd = jax.shard_map(fwd, mesh=mesh, in_specs=(P("bf"), P()),
+                           out_specs=P(), check_vma=False)
+    sm_grad = jax.shard_map(jax.grad(loss), mesh=mesh,
+                            in_specs=(P("bf"), P()), out_specs=P("bf"),
+                            check_vma=False)
+    w = jnp.zeros((N, 16, 16), jnp.float32)
+    x = jnp.zeros((n_micro, 2, 16), jnp.float32)
+    hlo_fwd = _compiled_hlo(sm_fwd, w, x)
+    assert _count_permutes(hlo_fwd) == 1, hlo_fwd.count("collective-permute")
+    # the scan stayed a loop: one while op, not M+S-1 unrolled bodies
+    assert "while" in hlo_fwd
+    hlo_grad = _compiled_hlo(sm_grad, w, x)
+    assert _count_permutes(hlo_grad) == 2
+
+
 def test_allreduce_baseline_uses_no_permute_but_psum(mesh):
     """Sanity contrast: the centralized baseline lowers to all-reduce, the
     decentralized combine to collective-permute — they are genuinely

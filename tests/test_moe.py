@@ -164,3 +164,78 @@ def test_moe_aux_loss_exposed():
     # one scalar per MoE layer, >= 1 (perfect balance == 1)
     assert len(leaves) == cfg.n_layers
     assert all(float(l) >= 0.99 for l in leaves)
+
+
+def test_moe_aux_loss_exposed_under_scan():
+    """The scanned stack declares an intermediates axis, so the aux loss
+    is retrievable under scan_layers too (it used to be silently absent
+    — exactly the layout --pp forces)."""
+    cfg = _cfg(scan_layers=True)
+    m = models.Llama(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (B, T), 0, 256)
+    v = m.init(jax.random.PRNGKey(1), toks)
+    _, inter = m.apply(v, toks, mutable=["intermediates"])
+    leaves = jax.tree.leaves(inter)
+    total = sum(float(np.sum(np.asarray(l))) for l in leaves)
+    n_vals = sum(np.asarray(l).size for l in leaves)
+    assert n_vals == cfg.n_layers  # stacked [n_layers] instead of n leaves
+    assert total / cfg.n_layers >= 0.99
+
+
+def test_moe_grouped_routing_matches_ungrouped_with_ample_capacity():
+    """With capacity large enough that no token is ever dropped, grouped
+    routing (the O(s)-memory path) computes the SAME mixture as one
+    global group: every token reaches its top-k experts with the same
+    gates regardless of which slot it lands in."""
+    # worst case: all G tokens of a group pick the same expert =>
+    # cap >= G*top_k requires capacity_factor >= n_experts
+    amp = dict(capacity_factor=4.0)  # == n_experts
+    m_one = models.Llama(_cfg(moe_group_size=0, **amp))
+    m_grp = models.Llama(_cfg(moe_group_size=8, **amp))
+    toks = jax.random.randint(jax.random.PRNGKey(0), (B, T), 0, 256)
+    v = m_one.init(jax.random.PRNGKey(1), toks)
+    a = np.asarray(m_one.apply(v, toks))
+    b = np.asarray(m_grp.apply(v, toks))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_moe_pp_loss_includes_aux():
+    """Pipeline-parallel MoE training carries the load-balance signal:
+    with n_micro=1 the psum'd pp loss equals plain CE + w * total aux
+    exactly (each stage contributes its own layers' aux)."""
+    from bluefog_tpu.models.llama import llama_pp_loss_fn
+
+    n_bf, n_pp = 2, 2
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(n_bf, n_pp),
+                ("bf", "pp"))
+    w = 0.5
+    cfg = _cfg(scan_layers=True, moe_aux_weight=w)
+    m = models.Llama(cfg)
+    variables = m.init(jax.random.PRNGKey(1), jnp.zeros((B, 8), jnp.int32))
+    specs = llama_param_specs(variables, tp_axis=None, ep_axis=None,
+                              pp_axis="pp")
+    opt = optax.sgd(0.1)
+    opt_specs = F.optax_state_specs(opt, variables, specs)
+    step = F.build_train_step(
+        llama_pp_loss_fn(cfg, pp_axis="pp", n_stages=n_pp, n_micro=1),
+        opt, mesh, comm_mode="none", pp_axis="pp", batch_specs=P("bf"),
+        param_specs=specs, opt_state_specs=opt_specs, donate=False)
+    params = F.rank_major(variables, mesh, specs=specs)
+    opt_state = F.rank_major(opt.init(variables), mesh, specs=opt_specs)
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, 256, (n_bf, B, T + 1)).astype(np.int32)
+    sharding = NamedSharding(mesh, P("bf"))
+    batch = (jax.device_put(raw[:, :, :-1], sharding),
+             jax.device_put(raw[:, :, 1:], sharding))
+    _, _, loss = step(params, opt_state, batch, jnp.int32(0))
+    loss = np.asarray(loss)
+
+    for r in range(n_bf):
+        logits, inter = m.apply(variables, raw[r, :, :-1],
+                                mutable=["intermediates"])
+        ce = float(jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+            logits, raw[r, :, 1:])))
+        aux = sum(float(np.sum(np.asarray(l)))
+                  for l in jax.tree.leaves(inter))
+        np.testing.assert_allclose(loss[r], ce + w * aux, rtol=1e-5,
+                                   atol=1e-5)
