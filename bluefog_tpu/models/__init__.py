@@ -22,6 +22,7 @@ from bluefog_tpu.models.llama import (
     llama_param_specs,
     llama_pp_loss_fn,
 )
+from bluefog_tpu.models.generate import init_cache, llama_generate
 from bluefog_tpu.models.vit import ViT, ViTConfig, ViT_B16, ViT_S16
 
 __all__ = [
@@ -41,4 +42,6 @@ __all__ = [
     "LlamaConfig",
     "llama_param_specs",
     "llama_pp_loss_fn",
+    "llama_generate",
+    "init_cache",
 ]

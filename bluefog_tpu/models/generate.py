@@ -1,0 +1,119 @@
+"""Autoregressive generation with K/V caching.
+
+The reference framework is training-only; users of an LLM framework also
+need inference.  This is the TPU-native decode loop: one prefill call
+writes the prompt's K/V into per-layer caches (flax "cache" collection),
+then a single ``lax.scan`` emits tokens one at a time — the whole
+generation is jittable (static prompt length / token budget / cache
+size), with no per-token host round trips beyond the final fetch.
+
+Trained parameters decode directly: ``decode=True`` changes no param
+shapes (``LlamaConfig.decode``), and both layer layouts (unrolled and
+``scan_layers``) carry caches (the scanned stack declares a cache axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bluefog_tpu.models.llama import Llama, LlamaConfig
+
+__all__ = ["init_cache", "llama_generate"]
+
+
+def _decode_cfg(cfg: LlamaConfig, max_len: int) -> LlamaConfig:
+    """Single-device replicated decode: every mesh-axis knob is cleared
+    (the sharded axes are training-time layouts; generate takes
+    replicated params)."""
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "llama_generate does not support MoE configs yet: expert "
+            "capacity drops depend on how many tokens route together, so "
+            "a cached decode (one token at a time) would not reproduce "
+            "the full-forward logits token-for-token")
+    return dataclasses.replace(
+        cfg, decode=True, max_seq_len=max_len, attn_mode="full",
+        attn_impl="xla", sp_axis=None, tp_axis=None, tp_size=1,
+        ep_axis=None, ep_size=1, remat=False, remat_policy="none")
+
+
+def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int):
+    """Zero K/V caches for ``batch_size`` sequences of up to ``max_len``
+    tokens — built from shapes only (``jax.eval_shape``), no forward
+    pass, no params needed."""
+    model = Llama(_decode_cfg(cfg, max_len))
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((batch_size, 1), jnp.int32)))
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        shapes["cache"])
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
+                                   "max_len"))
+def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
+                   max_new_tokens: int, *, temperature: float = 0.0,
+                   rng: Optional[jax.Array] = None,
+                   max_len: Optional[int] = None) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt``.
+
+    Args:
+      variables: ``{"params": ...}`` from training / HF import (any
+        layer layout; model-parallel shardings are the caller's concern —
+        pass replicated params here).
+      cfg: the model's config (its ``decode``/``max_seq_len`` are
+        overridden internally).
+      prompt: ``[B, T_prompt]`` int32 token ids.
+      max_new_tokens: number of tokens to emit (static).
+      temperature: 0 = greedy argmax; otherwise softmax sampling at this
+        temperature (needs ``rng``).
+      max_len: cache length; defaults to ``T_prompt + max_new_tokens``.
+
+    Returns ``[B, T_prompt + max_new_tokens]`` int32: prompt ‖ generation.
+    """
+    b, t_prompt = prompt.shape
+    total = t_prompt + max_new_tokens
+    max_len = max_len or total
+    if max_len < total:
+        raise ValueError(f"max_len ({max_len}) < prompt + new tokens "
+                         f"({total})")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs rng=")
+    model = Llama(_decode_cfg(cfg, max_len))
+    params = {"params": variables["params"]}
+    cache = init_cache(cfg, b, max_len)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def sample(logits_last, rng):
+        if temperature == 0.0:
+            return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            rng, logits_last / temperature, axis=-1).astype(jnp.int32)
+
+    # prefill: one multi-token call writes the prompt K/V
+    logits, mut = model.apply({**params, "cache": cache}, prompt,
+                              mutable=["cache"])
+    rng, sub = jax.random.split(rng)
+    tok = sample(logits[:, -1], sub)
+
+    def step(carry, _):
+        cache, tok, rng = carry
+        logits, mut = model.apply({**params, "cache": cache}, tok[:, None],
+                                  mutable=["cache"])
+        rng, sub = jax.random.split(rng)
+        nxt = sample(logits[:, -1], sub)
+        return (mut["cache"], nxt, rng), tok
+
+    (_, last, _), toks = lax.scan(step, (mut["cache"], tok, rng), None,
+                                  length=max_new_tokens - 1)
+    generated = jnp.concatenate(
+        [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1) \
+        if max_new_tokens > 1 else tok[:, None]
+    return jnp.concatenate([prompt, generated], axis=1)

@@ -97,6 +97,12 @@ class LlamaConfig:
     # re-executes Python per layer).
     scan_layers: bool = False
     remat_policy: str = "none"  # none | dots | everything (with remat)
+    # Autoregressive decoding: attention layers keep [B, max_seq_len]
+    # K/V caches (flax "cache" collection) and attend incrementally —
+    # see models/generate.py.  Training configs leave this False; the
+    # param tree is identical either way, so trained params decode
+    # directly.
+    decode: bool = False
     # Final logits matmul precision (MaxText's logits_dot_in_fp32): True
     # runs the [*, dim] x [dim, vocab] head in f32 (stablest; the
     # default), False runs it in the compute dtype with the logits cast
@@ -104,6 +110,11 @@ class LlamaConfig:
     logits_dot_in_fp32: bool = True
 
     def __post_init__(self):
+        if self.decode and self.attn_mode != "full":
+            raise ValueError(
+                f"decode=True requires attn_mode='full' (got "
+                f"{self.attn_mode!r}); incremental K/V caching and "
+                "ring/blockwise attention do not compose")
         valid = ("none", "dots", "everything")
         if self.remat_policy not in valid:
             raise ValueError(
@@ -264,28 +275,67 @@ class Attention(nn.Module):
         q = dense(n_q * hd, "wq")(x).reshape(b, t, n_q, hd)
         k = dense(n_kv * hd, "wk")(x).reshape(b, t, n_kv, hd)
         v = dense(n_kv * hd, "wv")(x).reshape(b, t, n_kv, hd)
-        positions = pos_offset + jnp.arange(t)
-        q = rotary_embed(q, positions, cfg.rope_theta)
-        k = rotary_embed(k, positions, cfg.rope_theta)
-        if cfg.attn_mode == "ring":
-            assert cfg.sp_axis is not None, "ring attention needs sp_axis"
-            out = ring_attention(q, k, v, cfg.sp_axis, causal=True,
-                                 impl=cfg.attn_impl)
-        elif cfg.attn_impl == "flash":
-            from bluefog_tpu.parallel.pallas_attention import flash_attention
-
-            out = flash_attention(q, k, v, causal=True,
-                                  block_q=min(cfg.attn_block_size, t),
-                                  block_k=min(cfg.attn_block_size, t))
-        elif cfg.attn_mode == "blockwise":
-            out = blockwise_attention(q, k, v, cfg.attn_block_size, causal=True)
+        if cfg.decode:
+            # rotary happens inside, at the cache-index positions
+            out = self._decode_attend(q, k, v)
         else:
-            out = full_attention(q, k, v, causal=True)
+            positions = pos_offset + jnp.arange(t)
+            q = rotary_embed(q, positions, cfg.rope_theta)
+            k = rotary_embed(k, positions, cfg.rope_theta)
+            if cfg.attn_mode == "ring":
+                assert cfg.sp_axis is not None, "ring attention needs sp_axis"
+                out = ring_attention(q, k, v, cfg.sp_axis, causal=True,
+                                     impl=cfg.attn_impl)
+            elif cfg.attn_impl == "flash":
+                from bluefog_tpu.parallel.pallas_attention import (
+                    flash_attention)
+
+                out = flash_attention(q, k, v, causal=True,
+                                      block_q=min(cfg.attn_block_size, t),
+                                      block_k=min(cfg.attn_block_size, t))
+            elif cfg.attn_mode == "blockwise":
+                out = blockwise_attention(q, k, v, cfg.attn_block_size,
+                                          causal=True)
+            else:
+                out = full_attention(q, k, v, causal=True)
         out = out.reshape(b, t, n_q * hd)
         proj = dense(cfg.dim, "wo")(out)
         if tp:
             proj = _tp_region_out(proj, cfg.tp_axis)
         return proj
+
+    def _decode_attend(self, q, k, v):
+        """Incremental attention against the layer's K/V cache.
+
+        Appends this call's K/V at the cache index (rotary applied at the
+        true absolute positions), then attends the queries over the whole
+        cache with the causal mask in global coordinates
+        (``_block_scores`` with ``q_offset=index``).  Works for both the
+        multi-token prefill call and the one-token decode steps.
+        """
+        cfg = self.cfg
+        b, t, n_kv, hd = k.shape
+        max_len = cfg.max_seq_len
+        ck = self.variable("cache", "cached_key", jnp.zeros,
+                           (b, max_len, n_kv, hd), cfg.dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros,
+                           (b, max_len, n_kv, hd), cfg.dtype)
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((), jnp.int32))
+        idx = ci.value
+        positions = idx + jnp.arange(t)
+        q = rotary_embed(q, positions, cfg.rope_theta)
+        k = rotary_embed(k, positions, cfg.rope_theta)
+        zero = jnp.zeros((), idx.dtype)
+        k_all = lax.dynamic_update_slice(
+            ck.value, k.astype(cfg.dtype), (zero, idx, zero, zero))
+        v_all = lax.dynamic_update_slice(
+            cv.value, v.astype(cfg.dtype), (zero, idx, zero, zero))
+        ck.value, cv.value, ci.value = k_all, v_all, idx + t
+        # queries live at global positions [idx, idx+t); full_attention's
+        # q_offset places the causal mask there, which also excludes the
+        # cache's unwritten (zero) tail
+        return full_attention(q, k_all, v_all, causal=True, q_offset=idx)
 
 
 class FeedForward(nn.Module):
@@ -495,7 +545,7 @@ class Llama(nn.Module):
                                      prevent_cse=False)
             scan_cls = nn.scan(
                 body,
-                variable_axes={"params": 0, "intermediates": 0},
+                variable_axes={"params": 0, "intermediates": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=cfg.n_layers,
@@ -510,6 +560,11 @@ class Llama(nn.Module):
             for i in range(cfg.n_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(x, pos_offset)
         x = RMSNorm(cfg.norm_eps, name="norm")(x)
+        if cfg.decode:
+            # generation only ever samples from the final position — skip
+            # the other T-1 head matmuls and the [B, T, vocab] logits
+            # buffer (at 8k prompt x 128k vocab that is ~4 GB of f32)
+            x = x[:, -1:]
         head_dtype = jnp.float32 if cfg.logits_dot_in_fp32 else cfg.dtype
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=head_dtype,
                           param_dtype=jnp.float32, name="output")(x)
