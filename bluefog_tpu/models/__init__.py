@@ -19,6 +19,7 @@ from bluefog_tpu.models.resnet import (
 from bluefog_tpu.models.llama import (
     Llama,
     LlamaConfig,
+    llama_circular_layout,
     llama_param_specs,
     llama_pp_loss_fn,
 )
@@ -42,6 +43,7 @@ __all__ = [
     "LlamaConfig",
     "llama_param_specs",
     "llama_pp_loss_fn",
+    "llama_circular_layout",
     "llama_generate",
     "init_cache",
 ]

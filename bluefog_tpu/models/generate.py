@@ -55,8 +55,6 @@ def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int):
                         shapes["cache"])
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
-                                   "max_len"))
 def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
                    max_new_tokens: int, *, temperature: float = 0.0,
                    rng: Optional[jax.Array] = None,
@@ -70,13 +68,16 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
       cfg: the model's config (its ``decode``/``max_seq_len`` are
         overridden internally).
       prompt: ``[B, T_prompt]`` int32 token ids.
-      max_new_tokens: number of tokens to emit (static).
+      max_new_tokens: number of tokens to emit (static, >= 1).
       temperature: 0 = greedy argmax; otherwise softmax sampling at this
-        temperature (needs ``rng``).
+        temperature (needs ``rng``).  Traced — changing the temperature
+        does NOT recompile (only switching greedy <-> sampling does).
       max_len: cache length; defaults to ``T_prompt + max_new_tokens``.
 
     Returns ``[B, T_prompt + max_new_tokens]`` int32: prompt ‖ generation.
     """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens ({max_new_tokens}) must be >= 1")
     b, t_prompt = prompt.shape
     total = t_prompt + max_new_tokens
     max_len = max_len or total
@@ -85,14 +86,26 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
                          f"({total})")
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling needs rng=")
-    model = Llama(_decode_cfg(cfg, max_len))
-    params = {"params": variables["params"]}
-    cache = init_cache(cfg, b, max_len)
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    return _generate_impl(
+        variables, prompt, jnp.float32(temperature), rng,
+        cfg=_decode_cfg(cfg, max_len), max_new_tokens=max_new_tokens,
+        greedy=temperature == 0.0, max_len=max_len)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "greedy",
+                                   "max_len"))
+def _generate_impl(variables, prompt, temperature, rng, *,
+                   cfg: LlamaConfig, max_new_tokens: int, greedy: bool,
+                   max_len: int) -> jax.Array:
+    b = prompt.shape[0]
+    model = Llama(cfg)
+    params = {"params": variables["params"]}
+    cache = init_cache(cfg, b, max_len)
 
     def sample(logits_last, rng):
-        if temperature == 0.0:
+        if greedy:
             return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
         return jax.random.categorical(
             rng, logits_last / temperature, axis=-1).astype(jnp.int32)

@@ -21,6 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from flax import linen as nn
 
@@ -571,8 +572,30 @@ class Llama(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def llama_circular_layout(variables, n_stages: int, n_loops: int,
+                          inverse: bool = False):
+    """Permute the scanned block's layer axis into (or, with
+    ``inverse=True``, back out of) the circular-pipeline storage order —
+    apply BEFORE ``rank_major`` when training with
+    ``llama_pp_loss_fn(..., n_loops>1)``, and inversely when exporting a
+    checkpoint to the natural layer order.  See
+    ``parallel.pipeline.circular_layer_permutation``."""
+    from bluefog_tpu.parallel.pipeline import circular_layer_permutation
+
+    block = variables["params"]["layers"]["block"]
+    n_layers = jax.tree.leaves(block)[0].shape[0]
+    perm = circular_layer_permutation(n_layers, n_stages, n_loops)
+    if inverse:
+        perm = np.argsort(perm)
+    permuted = jax.tree.map(lambda a: jnp.take(a, perm, axis=0), block)
+    out = dict(variables)
+    out["params"] = dict(variables["params"])
+    out["params"]["layers"] = {"block": permuted}
+    return out
+
+
 def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
-                     n_micro: int):
+                     n_micro: int, n_loops: int = 1):
     """Build a next-token cross-entropy ``loss_fn(params, (inputs,
     targets))`` that runs the decoder stack as a GPipe pipeline over
     ``pp_axis`` (see ``bluefog_tpu.parallel.pipeline.gpipe``) — pipeline
@@ -596,16 +619,23 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
     offsets are derived from the sp shard index internally, and each sp
     shard's partial loss is averaged by the train step's ``sp_axis``
     reduction.  Batch size must divide by ``n_micro``.
+
+    ``n_loops > 1`` switches to the circular (interleaved) schedule:
+    each stage holds ``n_loops`` round-robin layer chunks and
+    microbatches ride the ring ``n_loops`` times, shrinking the bubble
+    to ``(S-1)/(n_loops*M + S-1)``.  Params must be permuted into the
+    circular storage order first (``llama_circular_layout``) and
+    ``n_micro >= n_stages`` is required.
     """
     if not cfg.scan_layers:
         raise ValueError("pipeline parallelism requires scan_layers=True "
                          "(the stacked-layer param layout is what shards "
                          "over the pipeline axis)")
-    if cfg.n_layers % n_stages:
+    if cfg.n_layers % (n_stages * n_loops):
         raise ValueError(f"n_layers ({cfg.n_layers}) must divide by "
-                         f"n_stages ({n_stages})")
+                         f"n_stages*n_loops ({n_stages}*{n_loops})")
 
-    from bluefog_tpu.parallel.pipeline import gpipe
+    from bluefog_tpu.parallel.pipeline import gpipe, gpipe_circular
 
     # the exact modules Llama.__call__ uses — applied to param subtrees,
     # so the pp path cannot diverge from the plain model's math
@@ -655,8 +685,19 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
             y, aux = lax.scan(body, x, lp)
             return y, jnp.sum(aux)
 
-        outs, aux_sum = gpipe(stage_fn, layer_p, x_micro, pp_axis,
-                              n_stages, with_aux=True)
+        if n_loops > 1:
+            # circular layout: this shard's [L/S] layers are its n_loops
+            # chunks in loop order (params permuted by
+            # llama_circular_layout before sharding)
+            chunks = jax.tree.map(
+                lambda a: a.reshape((n_loops, a.shape[0] // n_loops)
+                                    + a.shape[1:]), layer_p)
+            outs, aux_sum = gpipe_circular(
+                stage_fn, chunks, x_micro, pp_axis, n_stages, n_loops,
+                with_aux=True)
+        else:
+            outs, aux_sum = gpipe(stage_fn, layer_p, x_micro, pp_axis,
+                                  n_stages, with_aux=True)
         h = outs.reshape(b, t, cfg.dim)
         # final norm + head are pp-replicated params; every stage runs
         # them (SPMD lockstep — no extra wall-clock) but only the last

@@ -34,9 +34,10 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-__all__ = ["gpipe"]
+__all__ = ["gpipe", "gpipe_circular", "circular_layer_permutation"]
 
 
 def gpipe(stage_fn: Callable, stage_params, x_micro: jax.Array,
@@ -103,6 +104,122 @@ def gpipe(stage_fn: Callable, stage_params, x_micro: jax.Array,
             jnp.float32(0.0))
     (_, outputs, aux_sum), _ = lax.scan(
         tick, init, jnp.arange(n_micro + n_stages - 1))
+    if with_aux:
+        return outputs, aux_sum
+    return outputs
+
+
+def circular_layer_permutation(n_layers: int, n_stages: int,
+                               n_loops: int) -> np.ndarray:
+    """Layer-axis permutation that turns the natural ``[n_layers]`` stack
+    into the circular-pipeline storage layout.
+
+    Circular pipelining splits the stack into ``n_stages * n_loops``
+    chunks placed round-robin: chunk ``c`` (layers ``c*Lc .. (c+1)*Lc``)
+    lives on stage ``c % n_stages`` and runs on that stage's loop
+    ``c // n_stages``.  JAX shards a leading axis contiguously, so the
+    storage order must put each stage's ``n_loops`` chunks next to each
+    other: global slot ``(s, r, l)`` holds original layer
+    ``(r*n_stages + s)*Lc + l``.  Apply with ``jnp.take(leaf, perm,
+    axis=0)`` (and the argsort inverse to go back to the natural order,
+    e.g. for checkpoint export).
+    """
+    if n_layers % (n_stages * n_loops):
+        raise ValueError(f"n_layers ({n_layers}) must divide by "
+                         f"n_stages*n_loops ({n_stages}*{n_loops})")
+    lc = n_layers // (n_stages * n_loops)
+    perm = np.empty((n_layers,), np.int64)
+    g = 0
+    for s in range(n_stages):
+        for r in range(n_loops):
+            c = r * n_stages + s
+            for l in range(lc):
+                perm[g] = c * lc + l
+                g += 1
+    return perm
+
+
+def gpipe_circular(stage_fn: Callable, chunk_params, x_micro: jax.Array,
+                   pp_axis: str, n_stages: int, n_loops: int,
+                   with_aux: bool = False):
+    """Circular (interleaved) pipeline over ``pp_axis``.
+
+    Each stage holds ``n_loops`` parameter chunks (round-robin layer
+    placement — see :func:`circular_layer_permutation`) and every
+    microbatch rides the ring ``n_loops`` times, visiting chunks in layer
+    order.  The schedule is loop-major: stage ``s`` runs (microbatch
+    ``m``, loop ``r``) at tick ``r*M + m + s``, so the total tick count
+    is ``n_loops*M + S - 1`` and the bubble fraction drops from GPipe's
+    ``(S-1)/(M+S-1)`` to ``(S-1)/(n_loops*M + S-1)`` — the standard
+    interleaving refinement, for the price of ``n_loops``x more permute
+    hops per microbatch (each hop still a single nearest-neighbor
+    ppermute of one microbatch activation).
+
+    Requires ``M >= n_stages`` (the loop-major schedule stalls
+    otherwise) — activations returning to stage 0 for their next loop
+    wait in a FIFO of depth ``M - n_stages``.
+
+    Args:
+      stage_fn: ``(chunk_params_r, x) -> y`` (or ``(y, aux)`` with
+        ``with_aux``) — runs ONE chunk (``n_layers/(S*n_loops)``
+        layers); receives the ``r``-th slice of ``chunk_params``.
+      chunk_params: per-shard pytree whose leaves lead with
+        ``[n_loops, ...]`` — this stage's chunks in loop order.
+      x_micro / pp_axis / n_stages / with_aux: as in :func:`gpipe`.
+
+    Returns as :func:`gpipe` (outputs of the LAST chunk on the last
+    stage; garbage elsewhere — mask downstream).
+    """
+    n_micro = x_micro.shape[0]
+    if n_micro < n_stages:
+        raise ValueError(
+            f"circular pipeline needs n_micro ({n_micro}) >= n_stages "
+            f"({n_stages}) — the loop-major schedule stalls otherwise")
+    if n_loops == 1:
+        squeeze = jax.tree.map(lambda a: a[0], chunk_params)
+        return gpipe(stage_fn, squeeze, x_micro, pp_axis, n_stages,
+                     with_aux=with_aux)
+    stage = lax.axis_index(pp_axis)
+    shift = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    depth = n_micro - n_stages  # FIFO delay for loop re-entry at stage 0
+
+    def tick(carry, t):
+        state, fifo, outputs, aux_acc = carry
+        # stage s processes (microbatch m, loop r) at tick t = r*M + m + s
+        rel = t - stage
+        m = jnp.clip(rel % n_micro, 0, n_micro - 1)
+        r = jnp.clip(rel // n_micro, 0, n_loops - 1)
+        active = jnp.logical_and(rel >= 0, (rel // n_micro) < n_loops)
+        inject = lax.dynamic_index_in_dim(x_micro, m, 0, keepdims=False)
+        if depth > 0:
+            feed, fifo = fifo[0], jnp.concatenate(
+                [fifo[1:], state[None]], axis=0)
+        else:
+            feed = state
+        x0 = jnp.where(rel // n_micro == 0, inject, feed)
+        x_in = jnp.where(stage == 0, x0, state)
+        params_r = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, r, 0, keepdims=False),
+            chunk_params)
+        if with_aux:
+            y, aux = stage_fn(params_r, x_in)
+            aux_acc = aux_acc + jnp.where(active, aux, 0.0)
+        else:
+            y = stage_fn(params_r, x_in)
+        write = jnp.logical_and(
+            jnp.logical_and(stage == n_stages - 1, active),
+            r == n_loops - 1)
+        cur = lax.dynamic_index_in_dim(outputs, m, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, y, cur), m, 0)
+        state = lax.ppermute(y, pp_axis, shift)
+        return (state, fifo, outputs, aux_acc), None
+
+    fifo0 = jnp.zeros((max(depth, 1),) + x_micro.shape[1:], x_micro.dtype)
+    init = (jnp.zeros_like(x_micro[0]), fifo0, jnp.zeros_like(x_micro),
+            jnp.float32(0.0))
+    (_, _, outputs, aux_sum), _ = lax.scan(
+        tick, init, jnp.arange(n_loops * n_micro + n_stages - 1))
     if with_aux:
         return outputs, aux_sum
     return outputs

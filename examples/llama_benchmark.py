@@ -53,8 +53,13 @@ parser.add_argument("--moe-aux-weight", type=float, default=0.01,
 parser.add_argument("--pp", type=int, default=1,
                     help="pipeline-parallel stages (GPipe over a pp mesh "
                     "axis; forces --scan-layers)")
+parser.add_argument("--pp-loops", type=int, default=1,
+                    help="circular-pipeline interleave factor (each stage "
+                    "holds this many round-robin layer chunks; bubble "
+                    "shrinks by the same factor)")
 parser.add_argument("--microbatches", type=int, default=0,
-                    help="pipeline microbatches (default 2*pp)")
+                    help="pipeline microbatches (default 2*pp, or pp "
+                    "with --pp-loops > 1 needing at least pp)")
 parser.add_argument("--attn-impl", default="xla", choices=["xla", "flash"])
 parser.add_argument("--scan-layers", action="store_true",
                     help="nn.scan the decoder stack (O(1) compile in depth)")
@@ -66,6 +71,9 @@ parser.add_argument("--no-remat", action="store_true",
                     "saves the recompute FLOPs)")
 parser.add_argument("--remat-policy", default="none",
                     choices=["none", "dots", "everything"])
+parser.add_argument("--layers", type=int, default=0,
+                    help="override the model's layer count (e.g. to give "
+                    "--model tiny enough layers for --pp x --pp-loops)")
 parser.add_argument("--num-warmup", type=int, default=3)
 parser.add_argument("--num-steps", type=int, default=10)
 args = parser.parse_args()
@@ -119,10 +127,17 @@ def main():
     n_micro = args.microbatches or (2 * n_pp if n_pp > 1 else 1)
     assert args.batch_size % n_micro == 0, (args.batch_size, n_micro)
     model_axis = "ep" if n_ep > 1 else "tp"
+    assert args.pp_loops == 1 or n_pp > 1, \
+        "--pp-loops > 1 only applies with --pp > 1"
     mesh = Mesh(np.array(devices).reshape(n_dp, n_model, n_pp, n_sp),
                 ("bf", model_axis, "pp", "sp"))
     cfg = make_config()
-    assert cfg.n_layers % n_pp == 0, (cfg.n_layers, n_pp)
+    if args.layers:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, n_layers=args.layers)
+    assert cfg.n_layers % (n_pp * args.pp_loops) == 0, \
+        (cfg.n_layers, n_pp, args.pp_loops)
     model = models.Llama(cfg)
     t_local = args.seq_len // n_sp
 
@@ -130,7 +145,8 @@ def main():
         from bluefog_tpu.models.llama import llama_pp_loss_fn
 
         loss_fn = llama_pp_loss_fn(cfg, pp_axis="pp", n_stages=n_pp,
-                                   n_micro=n_micro)
+                                   n_micro=n_micro,
+                                   n_loops=args.pp_loops)
     else:
         want_aux = cfg.n_experts > 0 and cfg.moe_aux_weight > 0.0
 
@@ -203,6 +219,10 @@ def main():
 
     def init_state():
         base = init_model.init(jax.random.PRNGKey(0), init_tokens)
+        if args.pp_loops > 1:
+            from bluefog_tpu.models.llama import llama_circular_layout
+
+            base = llama_circular_layout(base, n_pp, args.pp_loops)
         return {"params": base, "opt": opt.init(base)}
 
     state_specs = None

@@ -80,6 +80,26 @@ def test_generate_validates_inputs():
     moe = models.LlamaConfig.tiny(dtype=jnp.float32, n_experts=4)
     with pytest.raises(NotImplementedError, match="MoE"):
         llama_generate(variables, moe, jnp.asarray(prompt), NEW)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        llama_generate(variables, cfg, jnp.asarray(prompt), 0)
+
+
+def test_temperature_change_does_not_recompile():
+    """temperature is a traced operand: sweeping it shares ONE compiled
+    generation program (only greedy <-> sampling switches compile)."""
+    from bluefog_tpu.models.generate import _generate_impl
+
+    cfg, _, variables, prompt = _setup(False)
+    before = _generate_impl._cache_size()
+    a = llama_generate(variables, cfg, jnp.asarray(prompt), 3,
+                       temperature=0.7, rng=jax.random.PRNGKey(0))
+    mid = _generate_impl._cache_size()
+    b = llama_generate(variables, cfg, jnp.asarray(prompt), 3,
+                       temperature=1.3, rng=jax.random.PRNGKey(0))
+    after = _generate_impl._cache_size()
+    assert mid == before + 1
+    assert after == mid  # second temperature hit the same compilation
+    assert np.asarray(a).shape == np.asarray(b).shape
 
 
 def test_generate_clears_model_parallel_axes():
