@@ -44,7 +44,7 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
-    attn_mode: str = "full"  # full | blockwise | ring
+    attn_mode: str = "full"  # full | blockwise | ring | ulysses
     attn_impl: str = "xla"  # xla | flash (Pallas kernel; composes with
     #                         attn_mode="ring" incl. training — the ring
     #                         VJP re-runs the Pallas bwd per ring step)
@@ -287,6 +287,14 @@ class Attention(nn.Module):
                 assert cfg.sp_axis is not None, "ring attention needs sp_axis"
                 out = ring_attention(q, k, v, cfg.sp_axis, causal=True,
                                      impl=cfg.attn_impl)
+            elif cfg.attn_mode == "ulysses":
+                from bluefog_tpu.parallel.ulysses import ulysses_attention
+
+                assert cfg.sp_axis is not None, \
+                    "ulysses attention needs sp_axis"
+                out = ulysses_attention(q, k, v, cfg.sp_axis, causal=True,
+                                        impl=cfg.attn_impl,
+                                        block_size=cfg.attn_block_size)
             elif cfg.attn_impl == "flash":
                 from bluefog_tpu.parallel.pallas_attention import (
                     flash_attention)
@@ -659,8 +667,9 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
                              f"({n_micro})")
         x = embed.apply({"params": p["tok_embeddings"]}, inp)  # [B, T, D]
         pos_offset = 0
-        if cfg.attn_mode == "ring":
-            assert cfg.sp_axis is not None, "ring attention needs sp_axis"
+        if cfg.attn_mode in ("ring", "ulysses"):
+            assert cfg.sp_axis is not None, "sequence parallelism needs " \
+                "sp_axis"
             pos_offset = lax.axis_index(cfg.sp_axis) * t
         bm = b // n_micro
         x_micro = x.reshape(n_micro, bm, t, cfg.dim)

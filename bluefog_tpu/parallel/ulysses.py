@@ -1,0 +1,76 @@
+"""Ulysses (all-to-all) sequence parallelism.
+
+The second sequence-parallel flavor beside ring attention
+(``parallel/ring_attention.py``): instead of rotating K/V blocks around
+the ring, one ``all_to_all`` re-shards the activations from
+sequence-sharded to HEAD-sharded, every device runs ordinary full (or
+Pallas flash) attention over the COMPLETE sequence for its subset of
+heads, and a second ``all_to_all`` re-shards back (the DeepSpeed-Ulysses
+communication pattern).
+
+Trade-off vs ring attention, both first-class here:
+
+* Ulysses moves each activation twice per attention (2 all-to-alls of
+  the [B, T_local, H, D] block) regardless of sequence length; ring
+  moves K/V ``n-1`` times but overlaps every hop with block compute.
+* Ulysses caps the sp degree at the KV-head count (GQA: ``n_kv_heads %
+  sp == 0`` required); ring has no head constraint.
+* Ulysses runs one dense attention per device (best MXU shape, trivially
+  composes with the flash kernel); ring's blockwise online-softmax merge
+  adds VPU work.
+
+Under ``jax.grad`` the transpose of an ``all_to_all`` is the reverse
+``all_to_all`` — the backward falls out of autodiff, like every other
+collective in this framework.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from bluefog_tpu.parallel.ring_attention import full_attention
+
+__all__ = ["ulysses_attention"]
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool = True,
+                      scale: Optional[float] = None,
+                      impl: str = "xla",
+                      block_size: int = 512) -> jax.Array:
+    """All-to-all sequence-parallel attention.
+
+    Must run inside ``shard_map`` with ``axis_name`` bound.  q:
+    ``[B, T_local, H, D]``, k/v: ``[B, T_local, H_kv, D]`` — the local
+    sequence shard with ALL heads (rotary already applied at global
+    positions by the caller).  Returns ``[B, T_local, H, D]``.
+
+    ``H`` and ``H_kv`` must divide by the axis size.
+    """
+    n = lax.axis_size(axis_name)
+    h, n_kv = q.shape[2], k.shape[2]
+    if h % n or n_kv % n:
+        raise ValueError(
+            f"ulysses attention shards heads over the sp axis: n_heads "
+            f"({h}) and n_kv_heads ({n_kv}) must divide by its size ({n})")
+
+    def seq_to_heads(x):  # [B, T/n, H, D] -> [B, T, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if impl == "flash":
+        from bluefog_tpu.parallel.pallas_attention import flash_attention
+
+        t = qg.shape[1]
+        out = flash_attention(qg, kg, vg, causal=causal, scale=scale,
+                              block_q=min(block_size, t),
+                              block_k=min(block_size, t))
+    else:
+        out = full_attention(qg, kg, vg, causal=causal, scale=scale)
+    # [B, T, H/n, D] -> [B, T/n, H, D]
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)

@@ -41,7 +41,12 @@ parser.add_argument("--dist-optimizer", default="neighbor_allreduce",
                     choices=["neighbor_allreduce", "dynamic", "horovod",
                              "local"])
 parser.add_argument("--sp", type=int, default=1,
-                    help="sequence-parallel ways (ring attention)")
+                    help="sequence-parallel ways")
+parser.add_argument("--sp-mode", default="ring",
+                    choices=["ring", "ulysses"],
+                    help="sequence-parallel flavor: ring attention "
+                    "(K/V rotate over ICI) or ulysses (two all-to-alls, "
+                    "heads sharded during attention)")
 parser.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel ways (Megatron column->row)")
 parser.add_argument("--experts", type=int, default=0,
@@ -58,8 +63,8 @@ parser.add_argument("--pp-loops", type=int, default=1,
                     "holds this many round-robin layer chunks; bubble "
                     "shrinks by the same factor)")
 parser.add_argument("--microbatches", type=int, default=0,
-                    help="pipeline microbatches (default 2*pp, or pp "
-                    "with --pp-loops > 1 needing at least pp)")
+                    help="pipeline microbatches (default 2*pp; the "
+                    "circular schedule requires at least pp)")
 parser.add_argument("--attn-impl", default="xla", choices=["xla", "flash"])
 parser.add_argument("--scan-layers", action="store_true",
                     help="nn.scan the decoder stack (O(1) compile in depth)")
@@ -92,7 +97,7 @@ def make_config():
         if args.ep > 1:
             base.update(ep_axis="ep", ep_size=args.ep)
     if args.sp > 1:
-        base.update(attn_mode="ring", sp_axis="sp",
+        base.update(attn_mode=args.sp_mode, sp_axis="sp",
                     attn_impl=args.attn_impl)
     elif args.attn_impl == "flash":
         base.update(attn_impl="flash")
