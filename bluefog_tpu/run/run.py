@@ -63,6 +63,18 @@ def make_parser() -> argparse.ArgumentParser:
                         help="enable the timeline and write per-rank trace "
                              "files with this prefix (reference "
                              "run.py:106)")
+    parser.add_argument("--restarts", type=int, default=0,
+                        help="elastic recovery (single-host launches "
+                        "only): if a rank dies, tear the job down and "
+                        "relaunch it up to this many times (training "
+                        "scripts resume from their checkpoint; children "
+                        "see BLUEFOG_TPU_RESTART_ATTEMPT, and each "
+                        "attempt gets the next bindable coordinator "
+                        "port).  Multi-host restart needs a supervisor "
+                        "that coordinates every host's epoch — rejected "
+                        "here rather than half-working.  The reference "
+                        "has no restart story — its watchdog only names "
+                        "stalled ranks")
     parser.add_argument("--extra-env", action="append", default=[],
                         metavar="K=V", help="extra env for the children")
     parser.add_argument("command", nargs=argparse.REMAINDER,
@@ -70,12 +82,49 @@ def make_parser() -> argparse.ArgumentParser:
     return parser
 
 
-def _child_env(args, process_id: int) -> dict:
+def _coordinator_for_attempt(coordinator: str, attempt: int) -> str:
+    """Fresh port per restart attempt: the previous epoch's coordinator
+    socket may linger in TIME_WAIT after a crash teardown.  Candidates
+    are probed for bindability starting at base+attempt so a port owned
+    by another process (e.g. a second job's live coordinator) is skipped
+    instead of burning the restart budget.  Ports stay NEAR the base —
+    an OS-assigned ephemeral port must not be used here, because between
+    this probe and the child's bind it can be claimed as the SOURCE port
+    of any outgoing connection on the host (observed: the restarted
+    epoch's clients then hang in connect forever).  Single-host only
+    (the parent picks the port and every child inherits it through the
+    env), which is the scope --restarts is restricted to."""
+    if attempt == 0:
+        return coordinator
+    import socket
+
+    host, _, port = coordinator.rpartition(":")
+    lo = min(int(port) + attempt, 65535)
+    for candidate in range(lo, min(lo + 100, 65536)):
+        try:
+            s = socket.socket()
+            s.bind((host or "127.0.0.1", candidate))
+            s.close()
+            return f"{host}:{candidate}"
+        except OSError:
+            continue
+    raise RuntimeError(
+        f"no bindable coordinator port within 100 of {port}")
+
+
+def _child_env(args, process_id: int, attempt: int = 0,
+               coordinator: str = None) -> dict:
     env = {k: v for k, v in os.environ.items()
            if k.startswith(PASS_PREFIXES)}
-    env["BLUEFOG_TPU_COORDINATOR"] = args.coordinator
+    # the coordinator must be resolved ONCE per attempt (per-child
+    # probing could hand ranks different addresses once rank 0's
+    # service binds the first candidate)
+    env["BLUEFOG_TPU_COORDINATOR"] = (
+        coordinator if coordinator is not None
+        else _coordinator_for_attempt(args.coordinator, attempt))
     env["BLUEFOG_TPU_NUM_PROCESSES"] = str(args.num_proc)
     env["BLUEFOG_TPU_PROCESS_ID"] = str(process_id)
+    env["BLUEFOG_TPU_RESTART_ATTEMPT"] = str(attempt)
     if args.force_cpu_devices:
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
@@ -95,25 +144,8 @@ def _stream(proc: subprocess.Popen, rank: int):
         sys.stdout.flush()
 
 
-def main(argv=None) -> int:
-    args = make_parser().parse_args(argv)
-    if args.version:
-        from bluefog_tpu.version import __version__
-        print(f"bfrun (bluefog_tpu) {__version__}")
-        return 0
-    if not args.command:
-        make_parser().print_usage()
-        return 2
-
-    command = args.command
-    if command and command[0] == "--":
-        command = command[1:]
-    procs_per_host = args.procs_per_host or args.num_proc
-    base_id = args.host_rank * procs_per_host
-    if base_id + procs_per_host > args.num_proc:
-        sys.stderr.write("bfrun: host-rank/procs-per-host exceed -np\n")
-        return 2
-
+def _run_once(args, command, base_id: int, procs_per_host: int,
+              attempt: int) -> int:
     children = []
     threads = []
 
@@ -125,9 +157,10 @@ def main(argv=None) -> int:
                 except OSError:
                     pass
 
+    coordinator = _coordinator_for_attempt(args.coordinator, attempt)
     try:
         for i in range(procs_per_host):
-            env = _child_env(args, base_id + i)
+            env = _child_env(args, base_id + i, attempt, coordinator)
             proc = subprocess.Popen(
                 command, env=env, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True)
@@ -161,10 +194,55 @@ def main(argv=None) -> int:
         _terminate_all(signal.SIGINT)
         for proc in children:
             proc.wait()
-        return 130
+        # sentinel distinct from any child exit code (a child exiting
+        # 130 must still be eligible for --restarts)
+        return None
     except Exception:
         _terminate_all()
         raise
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    if args.version:
+        from bluefog_tpu.version import __version__
+        print(f"bfrun (bluefog_tpu) {__version__}")
+        return 0
+    if not args.command:
+        make_parser().print_usage()
+        return 2
+
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    procs_per_host = args.procs_per_host or args.num_proc
+    base_id = args.host_rank * procs_per_host
+    if base_id + procs_per_host > args.num_proc:
+        sys.stderr.write("bfrun: host-rank/procs-per-host exceed -np\n")
+        return 2
+    if args.restarts and procs_per_host != args.num_proc:
+        # A remote rank's death is invisible to this host's monitor (its
+        # local children just block in rendezvous), and a restarted host
+        # would rendezvous on a port the surviving hosts never learn —
+        # refuse rather than hang half a pod.
+        sys.stderr.write(
+            "bfrun: --restarts only supports single-host launches "
+            "(multi-host elastic restart needs a cross-host supervisor)\n")
+        return 2
+
+    attempt = 0
+    while True:
+        rc = _run_once(args, command, base_id, procs_per_host, attempt)
+        if rc is None:  # KeyboardInterrupt: never restart
+            return 130
+        if rc == 0 or attempt >= args.restarts:
+            return rc
+        attempt += 1
+        sys.stderr.write(
+            f"bfrun: job failed (rc {rc}); elastic restart "
+            f"{attempt}/{args.restarts} — children resume from their "
+            "checkpoints\n")
+        time.sleep(1.0)
 
 
 if __name__ == "__main__":

@@ -134,6 +134,8 @@ def main():
     model_axis = "ep" if n_ep > 1 else "tp"
     assert args.pp_loops == 1 or n_pp > 1, \
         "--pp-loops > 1 only applies with --pp > 1"
+    assert args.sp_mode == "ring" or n_sp > 1, \
+        "--sp-mode only applies with --sp > 1"
     mesh = Mesh(np.array(devices).reshape(n_dp, n_model, n_pp, n_sp),
                 ("bf", model_axis, "pp", "sp"))
     cfg = make_config()

@@ -79,6 +79,20 @@ def test_llama_benchmark_tiny():
     assert "tokens_per_sec" in out
 
 
+def test_generate_text():
+    out = run_example("generate_text.py", "--max-new-tokens", "6")
+    assert "generated ids:" in out
+
+
+def test_llama_benchmark_pp_ulysses():
+    out = run_example(
+        "llama_benchmark.py", "--model", "tiny", "--layers", "4",
+        "--batch-size", "4", "--seq-len", "32", "--pp", "2", "--pp-loops",
+        "2", "--microbatches", "4", "--sp", "2", "--sp-mode", "ulysses",
+        "--num-warmup", "1", "--num-steps", "2", timeout=360)
+    assert "tokens_per_sec" in out
+
+
 def test_resnet_benchmark_tiny():
     out = run_example(
         "resnet_benchmark.py", "--model", "resnet18", "--batch-size", "4",
