@@ -45,12 +45,26 @@ class Checkpointer:
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
         )
 
-    def save(self, step: int, state: Any, force: bool = False) -> bool:
-        """Blocking save of a (rank-major) pytree at ``step``."""
+    def save(self, step: int, state: Any, force: bool = False,
+             blocking: bool = True) -> bool:
+        """Save a (rank-major) pytree at ``step``.
+
+        ``blocking=False`` returns as soon as the state is staged (orbax
+        saves on a background thread): training overlaps the checkpoint
+        I/O, the standard TPU recipe for large states.  Call
+        :meth:`wait` (or the next ``save``/``close``, which serialize
+        internally) before relying on the files being on disk — e.g.
+        before an elastic-restart epoch reads them.
+        """
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force)
-        self._mgr.wait_until_finished()
+        if blocking:
+            self._mgr.wait_until_finished()
         return saved
+
+    def wait(self) -> None:
+        """Block until every async save has committed to disk."""
+        self._mgr.wait_until_finished()
 
     def all_steps(self):
         return sorted(self._mgr.all_steps())

@@ -102,9 +102,8 @@ def _coordinator_for_attempt(coordinator: str, attempt: int) -> str:
     lo = min(int(port) + attempt, 65535)
     for candidate in range(lo, min(lo + 100, 65536)):
         try:
-            s = socket.socket()
-            s.bind((host or "127.0.0.1", candidate))
-            s.close()
+            with socket.socket() as s:
+                s.bind((host or "127.0.0.1", candidate))
             return f"{host}:{candidate}"
         except OSError:
             continue
@@ -112,16 +111,14 @@ def _coordinator_for_attempt(coordinator: str, attempt: int) -> str:
         f"no bindable coordinator port within 100 of {port}")
 
 
-def _child_env(args, process_id: int, attempt: int = 0,
-               coordinator: str = None) -> dict:
+def _child_env(args, process_id: int, attempt: int,
+               coordinator: str) -> dict:
     env = {k: v for k, v in os.environ.items()
            if k.startswith(PASS_PREFIXES)}
-    # the coordinator must be resolved ONCE per attempt (per-child
+    # the caller resolves the coordinator ONCE per attempt (per-child
     # probing could hand ranks different addresses once rank 0's
     # service binds the first candidate)
-    env["BLUEFOG_TPU_COORDINATOR"] = (
-        coordinator if coordinator is not None
-        else _coordinator_for_attempt(args.coordinator, attempt))
+    env["BLUEFOG_TPU_COORDINATOR"] = coordinator
     env["BLUEFOG_TPU_NUM_PROCESSES"] = str(args.num_proc)
     env["BLUEFOG_TPU_PROCESS_ID"] = str(process_id)
     env["BLUEFOG_TPU_RESTART_ATTEMPT"] = str(attempt)
@@ -145,7 +142,9 @@ def _stream(proc: subprocess.Popen, rank: int):
 
 
 def _run_once(args, command, base_id: int, procs_per_host: int,
-              attempt: int) -> int:
+              attempt: int):
+    """Returns the job's exit code, or None for KeyboardInterrupt (a
+    sentinel distinct from any child-reachable code — never restarted)."""
     children = []
     threads = []
 

@@ -177,3 +177,19 @@ def test_template_restore_mismatched_world_errors(tmp_path):
         ckpt.restore(0, small_mesh,
                      like={"x": np.ones((8, 2), np.float32)})
     ckpt.close()
+
+
+def test_async_save_overlaps_then_commits(tmp_path):
+    """blocking=False returns before the files are committed; wait()
+    makes them durable and the restore round-trips exactly."""
+    mesh = _mesh()
+    params = {"w": jax.device_put(
+        np.arange(8 * 4, dtype=np.float32).reshape(8, 4),
+        NamedSharding(mesh, P("bf")))}
+    ckpt = ckpt_mod.Checkpointer(str(tmp_path / "a"))
+    assert ckpt.save(1, {"params": params}, blocking=False)
+    ckpt.wait()
+    restored = ckpt.restore(1, mesh)
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.asarray(params["w"]))
+    ckpt.close()
