@@ -1,0 +1,194 @@
+"""Native interactive engines — ``ibfrun``'s self-contained backend.
+
+The reference's ``ibfrun`` drives notebook workflows through ipyparallel
+(reference bluefog/run/interactive_run.py: ipcontroller + mpirun'd
+ipengines + ``%%px``).  ipyparallel is an optional external dependency;
+this module is the dependency-free equivalent: each engine is a plain
+process holding a persistent namespace and listening on a localhost
+socket; the :class:`Client` broadcasts code to every engine and gathers
+results — the ``%%px`` execution model without the broker.
+
+Engines receive the same ``BLUEFOG_TPU_*`` wiring as ``bfrun`` children
+(see ``interactive_run.engine_env``), so ``import bluefog_tpu as bf;
+bf.init()`` executed through the client forms a real multi-process
+``jax.distributed`` job.  Because the client SENDS to every engine
+before READING any reply, collective operations work: all engines enter
+the collective concurrently.
+
+Transport is length-prefixed pickle over 127.0.0.1 sockets — a local
+development tool with the same trust model as ipyparallel's default
+profile (anyone with local access to the port files can execute code;
+do not expose the ports).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import sys
+import traceback
+from typing import Any, List, Optional
+
+__all__ = ["Client", "engine_main"]
+
+_LEN = struct.Struct(">Q")
+
+
+def _send(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv(sock: socket.socket) -> Any:
+    header = b""
+    while len(header) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(header))
+        if not chunk:
+            raise EOFError("engine connection closed")
+        header += chunk
+    n = _LEN.unpack(header)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise EOFError("engine connection closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+def engine_main(port_file: str) -> None:
+    """Engine process entry: listen on an ephemeral localhost port
+    (announced atomically through ``port_file``), then serve exec/eval
+    requests against one persistent namespace until shutdown."""
+    ns: dict = {"__name__": "__bluefog_engine__"}
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    with open(port_file + ".tmp", "w") as f:
+        f.write(str(port))
+    os.replace(port_file + ".tmp", port_file)
+    while True:
+        conn, _ = srv.accept()
+        try:
+            while True:
+                msg = _recv(conn)
+                op = msg.get("op")
+                if op == "shutdown":
+                    _send(conn, {"ok": True})
+                    conn.close()
+                    os._exit(0)
+                try:
+                    if op == "exec":
+                        exec(msg["code"], ns)
+                        _send(conn, {"ok": True})
+                    elif op == "eval":
+                        _send(conn, {"ok": True,
+                                     "value": eval(msg["expr"], ns)})
+                    else:
+                        _send(conn, {"ok": False,
+                                     "error": f"unknown op {op!r}"})
+                except Exception:
+                    _send(conn, {"ok": False,
+                                 "error": traceback.format_exc()})
+        except EOFError:
+            conn.close()  # client went away; await a new connection
+
+
+class EngineError(RuntimeError):
+    pass
+
+
+class Client:
+    """Drive a running native-engine cluster (``ibfrun start``).
+
+    ``Client(profile).execute("import bluefog_tpu as bf; bf.init()")``
+    runs on every engine concurrently; :meth:`eval` gathers per-engine
+    values (which must be picklable — fetch numpy, not jax.Array).
+    """
+
+    def __init__(self, profile: str = "bluefog",
+                 ports: Optional[List[int]] = None):
+        if ports is None:
+            from bluefog_tpu.run.interactive_run import load_state
+
+            state = load_state(profile)
+            if state is None or "engine_ports" not in state:
+                raise FileNotFoundError(
+                    f"no native engine cluster for profile '{profile}' — "
+                    "start one with: ibfrun start -np N")
+            ports = state["engine_ports"]
+        self._socks = []
+        for port in ports:
+            s = socket.create_connection(("127.0.0.1", port), timeout=60)
+            # the connect timeout must not persist per-operation: a cell
+            # running longer than it would raise mid-protocol and
+            # desynchronize the request/reply stream
+            s.settimeout(None)
+            self._socks.append(s)
+
+    def __len__(self):
+        return len(self._socks)
+
+    def _broadcast(self, msg: dict) -> List[dict]:
+        # send-to-all BEFORE read-any: engines may be entering a
+        # collective that only completes once every engine runs it
+        for s in self._socks:
+            _send(s, msg)
+        return [_recv(s) for s in self._socks]
+
+    def _raise_on_error(self, replies: List[dict], what: str):
+        errors = [(i, r["error"]) for i, r in enumerate(replies)
+                  if not r.get("ok")]
+        if errors:
+            detail = "\n".join(f"--- engine {i} ---\n{e}"
+                               for i, e in errors)
+            raise EngineError(f"{what} failed on "
+                              f"{len(errors)}/{len(replies)} engines:\n"
+                              f"{detail}")
+
+    def execute(self, code: str) -> None:
+        """Run ``code`` on every engine (persistent namespace)."""
+        self._raise_on_error(self._broadcast({"op": "exec", "code": code}),
+                             f"execute({code!r})")
+
+    def eval(self, expr: str) -> List[Any]:
+        """Evaluate ``expr`` on every engine; returns per-engine values."""
+        replies = self._broadcast({"op": "eval", "expr": expr})
+        self._raise_on_error(replies, f"eval({expr!r})")
+        return [r["value"] for r in replies]
+
+    def shutdown(self) -> None:
+        """Terminate every engine process (best-effort per engine: one
+        dead engine must not keep the others running)."""
+        for s in self._socks:
+            try:
+                _send(s, {"op": "shutdown"})
+            except OSError:
+                pass
+        for s in self._socks:
+            try:
+                _recv(s)
+            except (OSError, EOFError):
+                pass
+        self.close()
+
+    def close(self) -> None:
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+if __name__ == "__main__":
+    engine_main(sys.argv[1])

@@ -6,10 +6,22 @@ rank with bluefog initialized; notebook cells then drive the job with
 ``%%px``.  The TPU translation: engines are spawned directly (no mpirun),
 each with the ``BLUEFOG_TPU_{COORDINATOR,NUM_PROCESSES,PROCESS_ID}``
 environment that ``bluefog_tpu.init()`` turns into a
-``jax.distributed.initialize`` — so ``%%px import bluefog_tpu as bf;
-bf.init()`` forms the same multi-process job a ``bfrun`` launch would.
+``jax.distributed.initialize`` — so executing ``import bluefog_tpu as
+bf; bf.init()`` on the engines forms the same multi-process job a
+``bfrun`` launch would.
 
-State (engine pids, coordinator address) is kept in
+Two backends:
+
+* ``--backend native`` (default, no dependencies): the engines from
+  ``bluefog_tpu.run.engines`` — persistent-namespace processes on
+  localhost sockets, driven by ``engines.Client(profile)``
+  (``client.execute(...)`` / ``client.eval(...)`` — the ``%%px``
+  execution model without the broker).
+* ``--backend ipyparallel``: the reference-style ipcontroller +
+  ipengines for notebooks that want real ``%%px`` (requires
+  ipyparallel).
+
+State (engine pids/ports, coordinator address) is kept in
 ``~/.bluefog_tpu/ibfrun_<profile>.json`` (the reference keeps engine pids
 in the ipython profile dir, interactive_run.py:170-195) so ``ibfrun stop``
 can tear the cluster down even from a fresh shell.
@@ -60,13 +72,17 @@ def engine_env(process_id: int, num_proc: int, coordinator: str,
 
 
 def save_state(profile: str, controller_pid: int, engine_pids: List[int],
-               coordinator: str, num_proc: int) -> str:
+               coordinator: str, num_proc: int,
+               engine_ports: Optional[List[int]] = None) -> str:
     path = _state_path(profile)
+    state = {"controller_pid": controller_pid,
+             "engine_pids": engine_pids,
+             "coordinator": coordinator,
+             "num_proc": num_proc}
+    if engine_ports is not None:
+        state["engine_ports"] = engine_ports
     with open(path, "w") as f:
-        json.dump({"controller_pid": controller_pid,
-                   "engine_pids": engine_pids,
-                   "coordinator": coordinator,
-                   "num_proc": num_proc}, f)
+        json.dump(state, f)
     return path
 
 
@@ -90,6 +106,63 @@ def _kill(pid: int, sig=signal.SIGINT) -> bool:
         return True
     except (OSError, ProcessLookupError):
         return False
+
+
+def start_native_cluster(num_proc: int, profile: str, coordinator: str,
+                         force_cpu_devices: Optional[int] = None,
+                         engine_ready_timeout: float = 60.0) -> int:
+    """Start ``num_proc`` native engines (bluefog_tpu.run.engines) —
+    dependency-free; drive them with ``engines.Client(profile)``."""
+    import shutil
+    import tempfile
+
+    port_dir = tempfile.mkdtemp(prefix="ibfrun_ports_")
+    engines = []
+    try:
+        port_files = []
+        for i in range(num_proc):
+            env = engine_env(i, num_proc, coordinator, force_cpu_devices)
+            pf = os.path.join(port_dir, f"engine{i}.port")
+            port_files.append(pf)
+            engines.append(subprocess.Popen(
+                [sys.executable, "-m", "bluefog_tpu.run.engines", pf],
+                env=env))
+        deadline = time.time() + engine_ready_timeout
+        ports = []
+        for i, pf in enumerate(port_files):
+            while not os.path.exists(pf):
+                if time.time() > deadline:
+                    sys.stderr.write(
+                        f"ibfrun: engine {i} did not announce its port "
+                        f"within {engine_ready_timeout}s\n")
+                    raise TimeoutError
+                if engines[i].poll() is not None:
+                    sys.stderr.write(
+                        f"ibfrun: engine {i} exited "
+                        f"({engines[i].returncode}) during startup\n")
+                    raise TimeoutError
+                time.sleep(0.05)
+            with open(pf) as f:
+                ports.append(int(f.read().strip()))
+    except TimeoutError:
+        # a failed start must not orphan the engines that DID come up
+        # (they would squat BLUEFOG_TPU_* rendezvous state with no
+        # cluster record for 'ibfrun stop' to find)
+        for p in engines:
+            if p.poll() is None:
+                p.terminate()
+        return 1
+    finally:
+        shutil.rmtree(port_dir, ignore_errors=True)
+    path = save_state(profile, 0, [p.pid for p in engines], coordinator,
+                      num_proc, engine_ports=ports)
+    print(f"ibfrun: started {num_proc} native engines; state in {path}")
+    print("Drive them with:\n"
+          "  from bluefog_tpu.run.engines import Client\n"
+          f"  c = Client(profile={profile!r})\n"
+          "  c.execute('import bluefog_tpu as bf; bf.init()')\n"
+          "  c.eval('bf.rank()')")
+    return 0
 
 
 def start_cluster(num_proc: int, profile: str, coordinator: str,
@@ -129,9 +202,12 @@ def stop_cluster(profile: str) -> int:
         sys.stderr.write(f"ibfrun: no running cluster for profile "
                          f"'{profile}'\n")
         return 1
+    sig = (signal.SIGTERM if state.get("engine_ports")  # native engines
+           else signal.SIGINT)
     for pid in state["engine_pids"]:
-        _kill(pid)
-    _kill(state["controller_pid"])
+        _kill(pid, sig)
+    if state.get("controller_pid"):
+        _kill(state["controller_pid"])
     clear_state(profile)
     print(f"ibfrun: stopped cluster '{profile}'")
     return 0
@@ -144,6 +220,11 @@ def main(argv=None) -> int:
     parser.add_argument("action", choices=["start", "stop"])
     parser.add_argument("-np", "--num-proc", type=int, default=1)
     parser.add_argument("--profile", default="bluefog")
+    parser.add_argument("--backend", default="native",
+                        choices=["native", "ipyparallel"],
+                        help="native: dependency-free engines driven by "
+                        "engines.Client; ipyparallel: reference-style "
+                        "ipcontroller + %%px (requires ipyparallel)")
     parser.add_argument("--coordinator", default="127.0.0.1:7675",
                         help="jax.distributed coordinator address")
     parser.add_argument("--force-cpu-devices", type=int, default=None,
@@ -151,19 +232,24 @@ def main(argv=None) -> int:
                         help="simulate K CPU devices per engine (testing)")
     args = parser.parse_args(argv)
 
+    if args.action == "stop":
+        return stop_cluster(args.profile)
+    if args.backend == "native":
+        return start_native_cluster(args.num_proc, args.profile,
+                                    args.coordinator,
+                                    args.force_cpu_devices)
     try:
         import ipyparallel  # noqa: F401
     except ImportError:
         sys.stderr.write(
-            "ibfrun requires ipyparallel, which is not installed.\n"
-            "Single-process TPU notebooks do not need ibfrun: one process "
-            "addresses every chip — just `import bluefog_tpu` and init().\n")
+            "ibfrun --backend ipyparallel requires ipyparallel, which is "
+            "not installed; the default --backend native has no "
+            "dependencies.\nSingle-process TPU notebooks do not need "
+            "ibfrun: one process addresses every chip — just `import "
+            "bluefog_tpu` and init().\n")
         return 1
-
-    if args.action == "start":
-        return start_cluster(args.num_proc, args.profile, args.coordinator,
-                             args.force_cpu_devices)
-    return stop_cluster(args.profile)
+    return start_cluster(args.num_proc, args.profile, args.coordinator,
+                         args.force_cpu_devices)
 
 
 if __name__ == "__main__":

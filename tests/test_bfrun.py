@@ -279,3 +279,47 @@ def test_elastic_restart_resumes_training(tmp_path):
     # the trajectory differs slightly from an uninterrupted run)
     assert abs(results[0]["final"] - results[1]["final"]) < 1e-2
     assert abs(results[0]["final"] - 0.5) < 0.05, results
+
+
+def test_native_interactive_cluster(tmp_path, monkeypatch):
+    """ibfrun's dependency-free backend end-to-end: start 2 native
+    engines, drive a real jax.distributed job through engines.Client
+    (the %%px execution model), gather per-rank values, tear down —
+    the interactive workflow of reference interactive_run.py without
+    ipyparallel."""
+    monkeypatch.setenv("BLUEFOG_TPU_STATE_DIR", str(tmp_path))
+    monkeypatch.setenv("PYTHONPATH", REPO)
+    from bluefog_tpu.run import interactive_run as ir
+    from bluefog_tpu.run.engines import Client, EngineError
+
+    port = _free_port()
+    rc = ir.start_native_cluster(2, "testprof", f"127.0.0.1:{port}",
+                                 force_cpu_devices=2)
+    assert rc == 0
+    try:
+        c = Client("testprof")
+        assert len(c) == 2
+        c.execute("import numpy as np\n"
+                  "import jax\n"
+                  "import bluefog_tpu as bf\n"
+                  "bf.init()")
+        assert c.eval("bf.size()") == [4, 4]  # 2 procs x 2 devices
+        assert c.eval("jax.process_index()") == [0, 1]
+        # a collective across the engines (send-to-all-then-gather)
+        c.execute(
+            "x = bf.from_rank_values(lambda r: np.full((2,), float(r)))\n"
+            "for _ in range(20):\n"
+            "    x = bf.neighbor_allreduce(x)\n"
+            "mine = float(np.asarray(bf.to_rank_values(x)[\n"
+            "    jax.process_index() * bf.local_size()]).mean())")
+        vals = c.eval("mine")
+        assert all(abs(v - 1.5) < 1e-3 for v in vals), vals  # mean of 0..3
+        # errors surface with the engine's traceback
+        try:
+            c.execute("1/0")
+            raise AssertionError("expected EngineError")
+        except EngineError as e:
+            assert "ZeroDivisionError" in str(e)
+        c.shutdown()
+    finally:
+        ir.stop_cluster("testprof")
