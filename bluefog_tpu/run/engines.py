@@ -15,10 +15,14 @@ bf.init()`` executed through the client forms a real multi-process
 before READING any reply, collective operations work: all engines enter
 the collective concurrently.
 
-Transport is length-prefixed pickle over 127.0.0.1 sockets — a local
-development tool with the same trust model as ipyparallel's default
-profile (anyone with local access to the port files can execute code;
-do not expose the ports).
+Transport is length-prefixed pickle over 127.0.0.1 sockets.  Every
+connection must authenticate with the cluster's random token (generated
+at ``ibfrun start``, stored in the profile state file and handed to the
+engines through their environment) before any exec/eval is accepted —
+without it, any local user could connect to the port and run code as
+the engine owner.  This mirrors ipyparallel's signed-message model at
+the granularity a local dev tool needs; still: do not expose the ports
+beyond localhost.
 """
 
 from __future__ import annotations
@@ -61,7 +65,9 @@ def _recv(sock: socket.socket) -> Any:
 def engine_main(port_file: str) -> None:
     """Engine process entry: listen on an ephemeral localhost port
     (announced atomically through ``port_file``), then serve exec/eval
-    requests against one persistent namespace until shutdown."""
+    requests against one persistent namespace until shutdown.  Every
+    connection must authenticate first (``BLUEFOG_TPU_ENGINE_TOKEN``)."""
+    token = os.environ.get("BLUEFOG_TPU_ENGINE_TOKEN", "")
     ns: dict = {"__name__": "__bluefog_engine__"}
     srv = socket.socket()
     srv.bind(("127.0.0.1", 0))
@@ -73,6 +79,13 @@ def engine_main(port_file: str) -> None:
     while True:
         conn, _ = srv.accept()
         try:
+            hello = _recv(conn)
+            if not (hello.get("op") == "auth"
+                    and hello.get("token") == token):
+                _send(conn, {"ok": False, "error": "bad auth token"})
+                conn.close()
+                continue
+            _send(conn, {"ok": True})
             while True:
                 msg = _recv(conn)
                 op = msg.get("op")
@@ -93,8 +106,14 @@ def engine_main(port_file: str) -> None:
                 except Exception:
                     _send(conn, {"ok": False,
                                  "error": traceback.format_exc()})
-        except EOFError:
-            conn.close()  # client went away; await a new connection
+        except (EOFError, OSError):
+            # client went away (clean close OR reset/broken pipe with
+            # data in flight — e.g. a killed notebook kernel); await a
+            # new connection rather than dying with the job state
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 class EngineError(RuntimeError):
@@ -110,8 +129,9 @@ class Client:
     """
 
     def __init__(self, profile: str = "bluefog",
-                 ports: Optional[List[int]] = None):
-        if ports is None:
+                 ports: Optional[List[int]] = None,
+                 token: Optional[str] = None):
+        if ports is None or token is None:
             from bluefog_tpu.run.interactive_run import load_state
 
             state = load_state(profile)
@@ -119,15 +139,27 @@ class Client:
                 raise FileNotFoundError(
                     f"no native engine cluster for profile '{profile}' — "
                     "start one with: ibfrun start -np N")
-            ports = state["engine_ports"]
+            ports = ports if ports is not None else state["engine_ports"]
+            token = token if token is not None else state.get("token", "")
         self._socks = []
-        for port in ports:
-            s = socket.create_connection(("127.0.0.1", port), timeout=60)
-            # the connect timeout must not persist per-operation: a cell
-            # running longer than it would raise mid-protocol and
-            # desynchronize the request/reply stream
-            s.settimeout(None)
-            self._socks.append(s)
+        try:
+            for port in ports:
+                s = socket.create_connection(("127.0.0.1", port),
+                                             timeout=60)
+                # the connect timeout must not persist per-operation: a
+                # cell running longer than it would raise mid-protocol
+                # and desynchronize the request/reply stream
+                s.settimeout(None)
+                self._socks.append(s)
+                _send(s, {"op": "auth", "token": token})
+                reply = _recv(s)
+                if not reply.get("ok"):
+                    raise EngineError(
+                        f"engine on port {port} rejected the client: "
+                        f"{reply.get('error')}")
+        except BaseException:
+            self.close()
+            raise
 
     def __len__(self):
         return len(self._socks)

@@ -71,9 +71,16 @@ def engine_env(process_id: int, num_proc: int, coordinator: str,
     return env
 
 
+def _reap(procs) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+
+
 def save_state(profile: str, controller_pid: int, engine_pids: List[int],
                coordinator: str, num_proc: int,
-               engine_ports: Optional[List[int]] = None) -> str:
+               engine_ports: Optional[List[int]] = None,
+               token: Optional[str] = None) -> str:
     path = _state_path(profile)
     state = {"controller_pid": controller_pid,
              "engine_pids": engine_pids,
@@ -81,8 +88,12 @@ def save_state(profile: str, controller_pid: int, engine_pids: List[int],
              "num_proc": num_proc}
     if engine_ports is not None:
         state["engine_ports"] = engine_ports
+    if token is not None:
+        state["token"] = token
     with open(path, "w") as f:
         json.dump(state, f)
+    # the state file now carries the auth token — owner-only
+    os.chmod(path, 0o600)
     return path
 
 
@@ -113,15 +124,18 @@ def start_native_cluster(num_proc: int, profile: str, coordinator: str,
                          engine_ready_timeout: float = 60.0) -> int:
     """Start ``num_proc`` native engines (bluefog_tpu.run.engines) —
     dependency-free; drive them with ``engines.Client(profile)``."""
+    import secrets
     import shutil
     import tempfile
 
+    token = secrets.token_hex(16)
     port_dir = tempfile.mkdtemp(prefix="ibfrun_ports_")
     engines = []
     try:
         port_files = []
         for i in range(num_proc):
             env = engine_env(i, num_proc, coordinator, force_cpu_devices)
+            env["BLUEFOG_TPU_ENGINE_TOKEN"] = token
             pf = os.path.join(port_dir, f"engine{i}.port")
             port_files.append(pf)
             engines.append(subprocess.Popen(
@@ -145,17 +159,19 @@ def start_native_cluster(num_proc: int, profile: str, coordinator: str,
             with open(pf) as f:
                 ports.append(int(f.read().strip()))
     except TimeoutError:
-        # a failed start must not orphan the engines that DID come up
-        # (they would squat BLUEFOG_TPU_* rendezvous state with no
-        # cluster record for 'ibfrun stop' to find)
-        for p in engines:
-            if p.poll() is None:
-                p.terminate()
+        _reap(engines)
         return 1
+    except BaseException:
+        # ANY failed start (Popen OSError, Ctrl-C in the wait loop, ...)
+        # must not orphan the engines that DID come up — they would
+        # squat BLUEFOG_TPU_* rendezvous state with no cluster record
+        # for 'ibfrun stop' to find
+        _reap(engines)
+        raise
     finally:
         shutil.rmtree(port_dir, ignore_errors=True)
     path = save_state(profile, 0, [p.pid for p in engines], coordinator,
-                      num_proc, engine_ports=ports)
+                      num_proc, engine_ports=ports, token=token)
     print(f"ibfrun: started {num_proc} native engines; state in {path}")
     print("Drive them with:\n"
           "  from bluefog_tpu.run.engines import Client\n"

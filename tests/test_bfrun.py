@@ -320,6 +320,18 @@ def test_native_interactive_cluster(tmp_path, monkeypatch):
             raise AssertionError("expected EngineError")
         except EngineError as e:
             assert "ZeroDivisionError" in str(e)
-        c.shutdown()
+        # engines serve one connection at a time: detach, probe that a
+        # wrong token is rejected before any exec, then reconnect — the
+        # engine survives both the disconnect and the rejected attempt
+        c.close()
+        state = ir.load_state("testprof")
+        try:
+            Client(ports=state["engine_ports"], token="wrong")
+            raise AssertionError("expected auth rejection")
+        except EngineError as e:
+            assert "rejected" in str(e)
+        c2 = Client("testprof")
+        assert c2.eval("1 + 1") == [2, 2]
+        c2.shutdown()
     finally:
         ir.stop_cluster("testprof")
