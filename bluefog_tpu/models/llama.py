@@ -83,6 +83,11 @@ class LlamaConfig:
     # (exact original behavior); otherwise the effective group is the
     # largest divisor of the token count <= this value.
     moe_group_size: int = 4096
+    # Router flavor: "topk" (token-choice, autoregressive-safe, the
+    # Mixtral/Switch default) or "expert_choice" (each expert takes its
+    # top-capacity tokens per group — dropless and perfectly balanced by
+    # construction, but NOT causal; for encoder/bidirectional stacks).
+    moe_router: str = "topk"
     # Weight of the Switch-style load-balance auxiliary loss.  The loss
     # is always sown under "intermediates" (scan included); the shipped
     # loss builders (llama_benchmark, llama_pp_loss_fn) ADD
@@ -116,6 +121,13 @@ class LlamaConfig:
                 f"decode=True requires attn_mode='full' (got "
                 f"{self.attn_mode!r}); incremental K/V caching and "
                 "ring/blockwise attention do not compose")
+        if self.decode and self.n_experts:
+            raise ValueError(
+                "decode=True does not support MoE: routing groups/"
+                "capacities depend on how many tokens are processed "
+                "together, so a cached decode cannot reproduce the "
+                "full-forward logits token-for-token (see "
+                "models/generate.py)")
         valid = ("none", "dots", "everything")
         if self.remat_policy not in valid:
             raise ValueError(
@@ -137,6 +149,9 @@ class LlamaConfig:
                 raise ValueError("ep_size > 1 requires ep_axis")
             if not self.n_experts:
                 raise ValueError("ep_size > 1 requires n_experts > 0")
+        if self.moe_router not in ("topk", "expert_choice"):
+            raise ValueError(f"moe_router {self.moe_router!r} not in "
+                             "('topk', 'expert_choice')")
         if self.n_experts:
             if self.n_experts % self.ep_size:
                 raise ValueError(
@@ -368,6 +383,60 @@ class FeedForward(nn.Module):
         return down
 
 
+def moe_combine_weights(probs: jax.Array, top_k: int, cap: int,
+                        router: str = "topk") -> jax.Array:
+    """Routing combine weights ``[g, G, E, cap]`` from per-group expert
+    probabilities ``probs [g, G, E]`` — a pure function so the routing
+    contract is unit-testable in isolation (tests/test_moe.py asserts
+    the occupancy/drop accounting directly on it).
+
+    ``router="topk"``: token-choice — each token takes its ``top_k``
+    experts, bounded by the per-expert per-group capacity ``cap``
+    (overflow tokens are dropped to the residual).  Autoregressive-safe.
+
+    ``router="expert_choice"`` (Zhou et al. 2022): each expert takes its
+    top-``cap`` tokens per group — dropless and perfectly load-balanced
+    BY CONSTRUCTION (no aux loss needed), but NOT causal (which earlier
+    tokens an expert keeps depends on later tokens in the group); for
+    encoder/bidirectional stacks.  ``cap`` is clamped to the group size.
+    """
+    g, G, E = probs.shape
+    if router == "expert_choice":
+        cap = min(cap, G)  # an expert cannot take more than G tokens
+        scores = jnp.swapaxes(probs, 1, 2)          # [g, E, G]
+        gate_vals, idx = lax.top_k(scores, cap)     # [g, E, cap]
+        onehot = jax.nn.one_hot(idx, G, dtype=jnp.float32)
+        # combine[g, s, e, c] = gate of token s in expert e's slot c
+        return jnp.einsum("gecs,gec->gsec", onehot, gate_vals)
+    # top-k selection: k rounds of argmax with masking (k is tiny)
+    masked = probs
+    combine = jnp.zeros((g, G, E, cap), jnp.float32)
+    counts = jnp.zeros((g, E), jnp.int32)
+    for _ in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)               # [g, G]
+        # gate from MASKED probs: if the softmax tail underflowed to
+        # exact zero, a later round's argmax re-picks an earlier expert —
+        # reading the unmasked prob would double-count it with full
+        # weight; the masked value is 0 for re-picks.
+        gate = jnp.take_along_axis(masked, idx[..., None],
+                                   axis=-1)[..., 0]     # [g, G]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)
+        # position of each token within its expert's per-group queue,
+        # offset by what previous rounds already enqueued
+        pos = jnp.cumsum(onehot, axis=1) - onehot + counts[:, None, :]
+        pos_tok = jnp.sum(pos * onehot, axis=-1)        # [g, G]
+        keep = pos_tok < cap
+        combine = combine + (
+            gate[..., None, None]
+            * jax.nn.one_hot(idx, E)[..., None]
+            * jax.nn.one_hot(pos_tok, cap)[..., None, :]
+            * keep[..., None, None])
+        counts = counts + jnp.sum(
+            onehot * keep[..., None].astype(jnp.int32), axis=1)
+        masked = masked * (1.0 - onehot.astype(masked.dtype))
+    return combine
+
+
 class MoEFeedForward(nn.Module):
     """Top-k routed mixture-of-experts SwiGLU FFN with expert parallelism.
 
@@ -428,34 +497,9 @@ class MoEFeedForward(nn.Module):
         logits = _tp_region_in(logits_raw, cfg.ep_axis) if ep else logits_raw
         probs = jax.nn.softmax(logits, axis=-1).reshape(g, G, E)
 
-        # top-k selection: k rounds of argmax with masking (k is tiny)
-        masked = probs
-        combine = jnp.zeros((g, G, E, cap), jnp.float32)
-        counts = jnp.zeros((g, E), jnp.int32)
-        for _ in range(cfg.moe_top_k):
-            idx = jnp.argmax(masked, axis=-1)                   # [g, G]
-            # gate from MASKED probs: if the softmax tail underflowed to
-            # exact zero, a later round's argmax re-picks an earlier
-            # expert — reading the unmasked prob would double-count it
-            # with full weight; the masked value is 0 for re-picks.
-            gate = jnp.take_along_axis(masked, idx[..., None],
-                                       axis=-1)[..., 0]         # [g, G]
-            onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)    # [g, G, E]
-            # position of each token within its expert's per-group queue,
-            # offset by what previous rounds already enqueued
-            pos = (jnp.cumsum(onehot, axis=1) - onehot
-                   + counts[:, None, :])
-            pos_tok = jnp.sum(pos * onehot, axis=-1)            # [g, G]
-            keep = pos_tok < cap
-            combine = combine + (
-                gate[..., None, None]
-                * jax.nn.one_hot(idx, E)[..., None]
-                * jax.nn.one_hot(pos_tok, cap)[..., None, :]
-                * keep[..., None, None])
-            counts = counts + jnp.sum(
-                onehot * keep[..., None].astype(jnp.int32), axis=1)
-            masked = masked * (1.0 - onehot.astype(masked.dtype))
-
+        combine = moe_combine_weights(probs, cfg.moe_top_k, cap,
+                                      cfg.moe_router)
+        cap = combine.shape[-1]  # expert_choice clamps cap to G
         dispatch = (combine > 0.0).astype(cfg.dtype)  # [g, G, E, cap]
         # my shard's expert slice
         if ep:

@@ -55,6 +55,10 @@ parser.add_argument("--ep", type=int, default=1,
                     help="expert-parallel ways (needs --experts)")
 parser.add_argument("--moe-aux-weight", type=float, default=0.01,
                     help="Switch load-balance aux loss weight (MoE only)")
+parser.add_argument("--moe-router", default="topk",
+                    choices=["topk", "expert_choice"],
+                    help="token-choice top-k (causal) or expert-choice "
+                    "(dropless, perfectly balanced; non-causal)")
 parser.add_argument("--pp", type=int, default=1,
                     help="pipeline-parallel stages (GPipe over a pp mesh "
                     "axis; forces --scan-layers)")
@@ -92,8 +96,12 @@ def make_config():
     if args.tp > 1:
         base.update(tp_axis="tp", tp_size=args.tp)
     if args.experts:
-        base.update(n_experts=args.experts,
-                    moe_aux_weight=args.moe_aux_weight)
+        # expert choice is perfectly balanced by construction — a Switch
+        # aux term would only perturb the objective
+        aux = (0.0 if args.moe_router == "expert_choice"
+               else args.moe_aux_weight)
+        base.update(n_experts=args.experts, moe_aux_weight=aux,
+                    moe_router=args.moe_router)
         if args.ep > 1:
             base.update(ep_axis="ep", ep_size=args.ep)
     if args.sp > 1:

@@ -36,12 +36,15 @@ def mesh():
                 ("bf", "ep"))
 
 
-def test_moe_forward_and_grads_match_single_shard(mesh):
-    """ep=2 forward AND gradients equal ep=1 for the same global params
-    (guards the f/g conjugate pair on the expert psum and the dynamic
-    expert-slice dispatch)."""
-    m1 = models.Llama(_cfg())
-    m2 = models.Llama(_cfg(ep_axis="ep", ep_size=N_EP))
+@pytest.mark.parametrize("router", ["topk", "expert_choice"])
+def test_moe_forward_and_grads_match_single_shard(mesh, router):
+    """ep=2 forward AND gradients equal ep=1 for the same global params,
+    for BOTH routers (guards the f/g conjugate pair on the expert psum
+    and the dynamic expert-slice dispatch; expert_choice additionally
+    exercises the top_k gate gradients)."""
+    m1 = models.Llama(_cfg(moe_router=router))
+    m2 = models.Llama(_cfg(moe_router=router, ep_axis="ep",
+                           ep_size=N_EP))
     tokens = jax.random.randint(jax.random.PRNGKey(0), (N_BF, B, T), 0, 256)
     targets = jax.random.randint(jax.random.PRNGKey(2), (N_BF, B, T), 0, 256)
     variables = m1.init(jax.random.PRNGKey(1), tokens[0])
@@ -197,6 +200,73 @@ def test_moe_grouped_routing_matches_ungrouped_with_ample_capacity():
     a = np.asarray(m_one.apply(v, toks))
     b = np.asarray(m_grp.apply(v, toks))
     np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_routing_occupancy_contracts():
+    """The routing contracts, asserted on the pure combine function:
+    expert-choice fills EVERY slot of EVERY expert (dropless, perfectly
+    balanced by construction); token-choice top-k assigns every token at
+    most top_k slots and never exceeds any expert's capacity."""
+    from bluefog_tpu.models.llama import moe_combine_weights
+
+    g, G, E, cap, k = 3, 16, 4, 5, 2
+    probs = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(0), (g, G, E)) * 3.0, -1)
+
+    ec = np.asarray(moe_combine_weights(probs, k, cap, "expert_choice"))
+    assert ec.shape == (g, G, E, cap)
+    # every (group, expert, slot) is occupied by exactly one token
+    per_slot = (ec > 0).sum(axis=1)          # [g, E, cap]
+    np.testing.assert_array_equal(per_slot, 1)
+
+    tk = np.asarray(moe_combine_weights(probs, k, cap, "topk"))
+    per_token = (tk > 0).sum(axis=(2, 3))    # [g, G]
+    assert per_token.max() <= k
+    per_expert = (tk > 0).sum(axis=(1, 3))   # [g, E]
+    assert per_expert.max() <= cap
+    # ample capacity: nothing dropped, every token got all k experts
+    roomy = np.asarray(moe_combine_weights(probs, k, G * k, "topk"))
+    np.testing.assert_array_equal(roomy.sum(axis=(2, 3)) > 0, True)
+    np.testing.assert_array_equal((roomy > 0).sum(axis=(2, 3)), k)
+
+
+def test_expert_choice_deterministic():
+    cfg = _cfg(moe_router="expert_choice", capacity_factor=1.0)
+    m = models.Llama(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (B, T), 0, 256)
+    v = m.init(jax.random.PRNGKey(1), toks)
+    a = np.asarray(m.apply(v, toks))
+    b = np.asarray(m.apply(v, toks))
+    np.testing.assert_array_equal(a, b)
+    assert np.all(np.isfinite(a))
+
+
+def test_expert_choice_trains():
+    cfg = _cfg(moe_router="expert_choice")
+    m = models.Llama(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (B, T), 0, 256)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, 256)
+    v = m.init(jax.random.PRNGKey(1), toks)
+
+    def loss_fn(p):
+        logits = m.apply(p, toks)
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, tgt))
+
+    opt = optax.sgd(0.3)
+    state = opt.init(v)
+    losses = []
+    for _ in range(20):
+        loss, g = jax.value_and_grad(loss_fn)(v)
+        updates, state = opt.update(g, state, v)
+        v = optax.apply_updates(v, updates)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_moe_router_validation():
+    with pytest.raises(ValueError, match="moe_router"):
+        _cfg(moe_router="nope")
 
 
 def test_moe_pp_loss_includes_aux():
