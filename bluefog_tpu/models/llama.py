@@ -88,6 +88,14 @@ class LlamaConfig:
     # top-capacity tokens per group — dropless and perfectly balanced by
     # construction, but NOT causal; for encoder/bidirectional stacks).
     moe_router: str = "topk"
+    # Expert-choice routing conditions each token's expert assignment on
+    # the OTHER tokens in its group — including future ones — so on this
+    # causal decoder stack train-time logits are not reproducible
+    # autoregressively.  Selecting it requires this explicit
+    # acknowledgement (e.g. for representation learning, distillation
+    # teachers, or ablations where autoregressive deployment is not the
+    # goal); otherwise __post_init__ refuses the combination.
+    allow_noncausal_router: bool = False
     # Weight of the Switch-style load-balance auxiliary loss.  The loss
     # is always sown under "intermediates" (scan included); the shipped
     # loss builders (llama_benchmark, llama_pp_loss_fn) ADD
@@ -152,6 +160,15 @@ class LlamaConfig:
         if self.moe_router not in ("topk", "expert_choice"):
             raise ValueError(f"moe_router {self.moe_router!r} not in "
                              "('topk', 'expert_choice')")
+        if self.moe_router == "expert_choice" \
+                and not self.allow_noncausal_router:
+            raise ValueError(
+                "moe_router='expert_choice' is non-causal (each token's "
+                "routing depends on later tokens in its group) but this "
+                "stack is a causal decoder: trained logits would not be "
+                "reproducible autoregressively.  Pass "
+                "allow_noncausal_router=True to acknowledge this "
+                "explicitly, or use moe_router='topk'.")
         if self.n_experts:
             if self.n_experts % self.ep_size:
                 raise ValueError(
@@ -500,6 +517,19 @@ class MoEFeedForward(nn.Module):
         combine = moe_combine_weights(probs, cfg.moe_top_k, cap,
                                       cfg.moe_router)
         cap = combine.shape[-1]  # expert_choice clamps cap to G
+        if 0 < cfg.moe_group_size < s and G < cfg.moe_group_size // 2:
+            # awkward token counts (odd/prime b*t) can collapse the
+            # divisor far below the requested group — per-group capacity
+            # shrinks with it and routing quality degrades silently;
+            # surface it (pad b*t to a rounder count to fix)
+            from bluefog_tpu.logging_util import get_logger
+            get_logger().warning(
+                "MoE grouped routing: token count %d has no divisor "
+                "near moe_group_size=%d; effective group collapsed to "
+                "%d (capacity %d tokens/expert/group). Pad the "
+                "batch*seq token count to a multiple of the group size "
+                "to restore routing quality.", s, cfg.moe_group_size,
+                G, cap)
         dispatch = (combine > 0.0).astype(cfg.dtype)  # [g, G, E, cap]
         # my shard's expert slice
         if ep:

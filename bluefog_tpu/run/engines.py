@@ -20,13 +20,20 @@ connection must authenticate with the cluster's random token (generated
 at ``ibfrun start``, stored in the profile state file and handed to the
 engines through their environment) before any exec/eval is accepted —
 without it, any local user could connect to the port and run code as
-the engine owner.  This mirrors ipyparallel's signed-message model at
-the granularity a local dev tool needs; still: do not expose the ports
-beyond localhost.
+the engine owner.  The handshake is a fixed-length RAW-BYTES HMAC
+challenge/response (engine sends a random nonce, client returns
+``HMAC-SHA256(token, nonce)``, compared with ``hmac.compare_digest``):
+no pickle is deserialized until after auth succeeds, so an unauthorized
+peer can never reach ``pickle.loads`` with attacker bytes, and the
+token itself never crosses the socket.  This mirrors ipyparallel's
+signed-message model at the granularity a local dev tool needs; still:
+do not expose the ports beyond localhost.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import os
 import pickle
 import socket
@@ -45,21 +52,28 @@ def _send(sock: socket.socket, obj: Any) -> None:
     sock.sendall(_LEN.pack(len(data)) + data)
 
 
-def _recv(sock: socket.socket) -> Any:
-    header = b""
-    while len(header) < _LEN.size:
-        chunk = sock.recv(_LEN.size - len(header))
-        if not chunk:
-            raise EOFError("engine connection closed")
-        header += chunk
-    n = _LEN.unpack(header)[0]
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             raise EOFError("engine connection closed")
         buf += chunk
-    return pickle.loads(bytes(buf))
+    return bytes(buf)
+
+
+def _recv(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, _LEN.size)
+    n = _LEN.unpack(header)[0]
+    return pickle.loads(_recv_exact(sock, n))
+
+
+_NONCE_LEN = 32
+_MAC_LEN = hashlib.sha256().digest_size
+
+
+def _auth_mac(token: str, nonce: bytes) -> bytes:
+    return hmac.new(token.encode(), nonce, hashlib.sha256).digest()
 
 
 def engine_main(port_file: str) -> None:
@@ -79,13 +93,17 @@ def engine_main(port_file: str) -> None:
     while True:
         conn, _ = srv.accept()
         try:
-            hello = _recv(conn)
-            if not (hello.get("op") == "auth"
-                    and hello.get("token") == token):
-                _send(conn, {"ok": False, "error": "bad auth token"})
+            # Fixed-length raw-bytes challenge/response BEFORE any
+            # pickle touches the wire: an unauthenticated peer must
+            # never reach pickle.loads (arbitrary-code gadget).
+            nonce = os.urandom(_NONCE_LEN)
+            conn.sendall(nonce)
+            mac = _recv_exact(conn, _MAC_LEN)
+            if not hmac.compare_digest(mac, _auth_mac(token, nonce)):
+                conn.sendall(b"\x00")
                 conn.close()
                 continue
-            _send(conn, {"ok": True})
+            conn.sendall(b"\x01")
             while True:
                 msg = _recv(conn)
                 op = msg.get("op")
@@ -151,12 +169,13 @@ class Client:
                 # and desynchronize the request/reply stream
                 s.settimeout(None)
                 self._socks.append(s)
-                _send(s, {"op": "auth", "token": token})
-                reply = _recv(s)
-                if not reply.get("ok"):
+                nonce = _recv_exact(s, _NONCE_LEN)
+                s.sendall(_auth_mac(token, nonce))
+                status = _recv_exact(s, 1)
+                if status != b"\x01":
                     raise EngineError(
                         f"engine on port {port} rejected the client: "
-                        f"{reply.get('error')}")
+                        "bad auth token")
         except BaseException:
             self.close()
             raise

@@ -135,18 +135,36 @@ def _child_env(args, process_id: int, attempt: int,
     return env
 
 
-def _stream(proc: subprocess.Popen, rank: int):
+# Child-output markers for the coordinator losing the bind race: the
+# probed port can be claimed between the parent's probe and the child's
+# bind (TOCTOU) — such an epoch is retried on the next candidate port
+# without consuming the --restarts budget.  A marker line must also
+# name the coordinator port, so a training script's OWN port collision
+# (metrics server etc.) cannot masquerade as the coordinator race.
+_BIND_FAILURE_MARKERS = ("Address already in use", "EADDRINUSE",
+                         "Failed to bind")
+
+
+def _stream(proc: subprocess.Popen, rank: int, coordinator: str,
+            bind_failed: threading.Event):
+    port = coordinator.rpartition(":")[2]
     for line in proc.stdout:
+        if any(m in line for m in _BIND_FAILURE_MARKERS) \
+                and (coordinator in line or f":{port}" in line):
+            bind_failed.set()
         sys.stdout.write(f"[{rank}]<stdout> {line}")
         sys.stdout.flush()
 
 
 def _run_once(args, command, base_id: int, procs_per_host: int,
-              attempt: int):
-    """Returns the job's exit code, or None for KeyboardInterrupt (a
-    sentinel distinct from any child-reachable code — never restarted)."""
+              attempt: int, port_bump: int = 0):
+    """Returns ``(exit_code, bind_failed)``; exit_code is None for
+    KeyboardInterrupt (a sentinel distinct from any child-reachable
+    code — never restarted).  ``bind_failed`` reports whether any child
+    hit a coordinator bind failure (the probe-to-bind TOCTOU race)."""
     children = []
     threads = []
+    bind_failed = threading.Event()
 
     def _terminate_all(sig=signal.SIGTERM):
         for proc in children:
@@ -156,7 +174,8 @@ def _run_once(args, command, base_id: int, procs_per_host: int,
                 except OSError:
                     pass
 
-    coordinator = _coordinator_for_attempt(args.coordinator, attempt)
+    coordinator = _coordinator_for_attempt(args.coordinator,
+                                           attempt + port_bump)
     try:
         for i in range(procs_per_host):
             env = _child_env(args, base_id + i, attempt, coordinator)
@@ -164,8 +183,10 @@ def _run_once(args, command, base_id: int, procs_per_host: int,
                 command, env=env, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True)
             children.append(proc)
-            t = threading.Thread(target=_stream, args=(proc, base_id + i),
-                                 daemon=True)
+            t = threading.Thread(
+                target=_stream,
+                args=(proc, base_id + i, coordinator, bind_failed),
+                daemon=True)
             t.start()
             threads.append(t)
         # One failed rank must bring the job down (the others may be
@@ -188,14 +209,14 @@ def _run_once(args, command, base_id: int, procs_per_host: int,
                 time.sleep(0.1)
         for t in threads:
             t.join(timeout=5)
-        return rc
+        return rc, bind_failed.is_set()
     except KeyboardInterrupt:
         _terminate_all(signal.SIGINT)
         for proc in children:
             proc.wait()
         # sentinel distinct from any child exit code (a child exiting
         # 130 must still be eligible for --restarts)
-        return None
+        return None, False
     except Exception:
         _terminate_all()
         raise
@@ -230,10 +251,23 @@ def main(argv=None) -> int:
         return 2
 
     attempt = 0
+    port_bump = 0
     while True:
-        rc = _run_once(args, command, base_id, procs_per_host, attempt)
+        rc, bind_failed = _run_once(args, command, base_id,
+                                    procs_per_host, attempt, port_bump)
         if rc is None:  # KeyboardInterrupt: never restart
             return 130
+        if rc != 0 and bind_failed and args.restarts and port_bump < 5:
+            # probe-to-bind TOCTOU: another process claimed the probed
+            # coordinator port first.  The epoch never really started —
+            # move to the next candidate port without charging the
+            # elastic-restart budget.
+            port_bump += 1
+            sys.stderr.write(
+                "bfrun: coordinator lost the port bind race; retrying "
+                f"on the next candidate (+{port_bump})\n")
+            time.sleep(0.5)
+            continue
         if rc == 0 or attempt >= args.restarts:
             return rc
         attempt += 1

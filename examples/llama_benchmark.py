@@ -102,6 +102,12 @@ def make_config():
                else args.moe_aux_weight)
         base.update(n_experts=args.experts, moe_aux_weight=aux,
                     moe_router=args.moe_router)
+        if args.moe_router == "expert_choice":
+            # benchmark-only acknowledgement: EC routing is non-causal,
+            # so the trained logits are not autoregressively reproducible
+            print("WARNING: --moe-router expert_choice is non-causal on "
+                  "this decoder stack (throughput/ablation use only)")
+            base.update(allow_noncausal_router=True)
         if args.ep > 1:
             base.update(ep_axis="ep", ep_size=args.ep)
     if args.sp > 1:

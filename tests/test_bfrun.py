@@ -335,3 +335,78 @@ def test_native_interactive_cluster(tmp_path, monkeypatch):
         c2.shutdown()
     finally:
         ir.stop_cluster("testprof")
+
+
+def test_restart_port_bind_race_not_charged(tmp_path):
+    """A restart epoch that dies to the coordinator port TOCTOU race
+    (probe succeeded, child bind lost) retries on the next candidate
+    port WITHOUT consuming the --restarts budget (ADVICE r2 low)."""
+    from bluefog_tpu.run import run as bfrun
+
+    counter = tmp_path / "runs"
+    child = textwrap.dedent(f"""
+        import os, pathlib, sys
+        p = pathlib.Path({str(counter)!r})
+        n = int(p.read_text()) if p.exists() else 0
+        p.write_text(str(n + 1))
+        if n < 2:
+            coord = os.environ["BLUEFOG_TPU_COORDINATOR"]
+            print(f"RuntimeError: Failed to bind {{coord}}: "
+                  "Address already in use")
+            sys.exit(1)
+        sys.exit(0)
+    """)
+    # two bind-race epochs + one success must fit in a budget of ONE
+    # restart — possible only if bind races are not charged against it
+    rc = bfrun.main(["-np", "1", "--restarts", "1",
+                     "--coordinator", f"127.0.0.1:{_free_port()}",
+                     sys.executable, "-c", child])
+    assert rc == 0
+    assert counter.read_text() == "3"
+
+
+def test_engine_rejects_preauth_pickle(tmp_path):
+    """An unauthenticated peer must never reach pickle.loads: the
+    handshake is raw-bytes HMAC, so a crafted pickle sent as the first
+    message is compared as a (wrong) MAC and dropped without being
+    deserialized (ADVICE r2: pickle.__reduce__ RCE before token check)."""
+    import pickle
+    import socket
+    import subprocess
+    import time
+
+    from bluefog_tpu.run import engines
+
+    sentinel = tmp_path / "pwned"
+    port_file = tmp_path / "port"
+    env = dict(os.environ, BLUEFOG_TPU_ENGINE_TOKEN="secret",
+               PYTHONPATH=REPO)
+    proc = subprocess.Popen([sys.executable, engines.__file__,
+                             str(port_file)], env=env)
+    try:
+        deadline = time.time() + 30
+        while not port_file.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        port = int(port_file.read_text())
+
+        class Evil:
+            def __reduce__(self):
+                return (open, (str(sentinel), "w"))
+
+        payload = pickle.dumps({"op": "auth", "token": Evil()})
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        engines._recv_exact(s, engines._NONCE_LEN)
+        # old protocol: this length-prefixed pickle would be loads()ed
+        # pre-auth; new protocol: first 32 bytes read as a MAC, rejected
+        s.sendall(engines._LEN.pack(len(payload)) + payload)
+        status = engines._recv_exact(s, 1)
+        assert status == b"\x00"
+        s.close()
+        assert not sentinel.exists(), "pre-auth pickle was deserialized!"
+        # engine survives the attack and still serves authenticated peers
+        c = engines.Client(ports=[port], token="secret")
+        assert c.eval("40 + 2") == [42]
+        c.shutdown()
+    finally:
+        proc.kill()
+        proc.wait()

@@ -27,7 +27,16 @@ def _cfg(**kw):
     base = dict(dtype=jnp.float32, n_experts=4, moe_top_k=2,
                 capacity_factor=2.0)
     base.update(kw)
+    if base.get("moe_router") == "expert_choice":
+        base.setdefault("allow_noncausal_router", True)
     return models.LlamaConfig.tiny(**base)
+
+
+def test_expert_choice_requires_acknowledgement():
+    """EC routing is non-causal; on this causal decoder it must be an
+    explicit opt-in (ADVICE r2 medium)."""
+    with pytest.raises(ValueError, match="non-causal"):
+        models.LlamaConfig.tiny(n_experts=4, moe_router="expert_choice")
 
 
 @pytest.fixture
