@@ -520,24 +520,28 @@ class BluefogContext:
     # ------------------------------------------------------------------ #
     # eager op execution
     # ------------------------------------------------------------------ #
-    def _shardmapped(self, key: Tuple, kernel: Callable) -> Callable:
+    def _shardmapped(self, key: Tuple, kernel: Callable,
+                     n_aux: int = 0) -> Callable:
         """Cache of jitted shard_map programs.  ``kernel`` maps a per-rank
-        tensor (no leading rank axis) to a per-rank result."""
+        tensor (no leading rank axis) to a per-rank result; ``n_aux``
+        extra operands (e.g. combine-weight vectors) are passed through
+        REPLICATED, so their values stay out of the compile-cache key."""
         fn = self._op_cache.get(key)
         if fn is None:
 
-            def wrapped(x):
-                return kernel(x[0])[None]
+            def wrapped(x, *aux):
+                return kernel(x[0], *aux)[None]
 
             sm = jax.shard_map(
-                wrapped, mesh=self.mesh, in_specs=P(AXIS), out_specs=P(AXIS),
+                wrapped, mesh=self.mesh,
+                in_specs=(P(AXIS),) + (P(),) * n_aux, out_specs=P(AXIS),
                 check_vma=False,
             )
             fn = jax.jit(sm)
             self._op_cache[key] = fn
         return fn
 
-    def run_op(self, key: Tuple, kernel: Callable, x) -> jax.Array:
+    def run_op(self, key: Tuple, kernel: Callable, x, *aux) -> jax.Array:
         """Dispatch one eager collective.  With the timeline enabled this
         records the reference's ENQUEUE_<OP> span around the host-side
         dispatch (reference torch/mpi_ops.cc:178-488 starts the span at the
@@ -547,10 +551,10 @@ class BluefogContext:
         op = str(key[0])
         tl = self.timeline
         if tl is None:
-            return self._shardmapped(key, kernel)(x)
+            return self._shardmapped(key, kernel, len(aux))(x, *aux)
         tl.start_activity(op, f"ENQUEUE_{op.upper()}")
         try:
-            return self._shardmapped(key, kernel)(x)
+            return self._shardmapped(key, kernel, len(aux))(x, *aux)
         finally:
             tl.end_activity(op)
 

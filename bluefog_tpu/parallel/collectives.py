@@ -46,6 +46,9 @@ __all__ = [
     "allgatherv",
     "neighbor_allreduce",
     "neighbor_allgather",
+    "edge_structure",
+    "class_recv_weights",
+    "self_weight_vector",
     "neighbor_allgather_padded",
     "in_neighbor_lists",
     "pair_gossip",
@@ -69,6 +72,57 @@ def _self_weights_of(spec: CommSpec) -> Sequence[float]:
     if isinstance(spec, Topology):
         return spec.self_weights
     return spec.self_weight_values
+
+
+_structure_cache: dict = {}
+
+
+def edge_structure(spec: DynamicTopology) -> DynamicTopology:
+    """The spec with all edge weights replaced by 1.0 — the compile-time
+    skeleton.  A DECLARED edge transfers even when its weight is 0.0
+    (matching the reference, which sends the scaled-by-zero payload,
+    mpi_controller.cc:594-600, rather than skipping the send).
+
+    Memoized on ``(size, edges)``: a weight schedule over one edge
+    structure builds a fresh spec every step, but the skeleton (and its
+    cached shift decomposition) is shared across all of them."""
+    key = (spec.size, spec.edges)
+    structure = _structure_cache.get(key)
+    if structure is None:
+        structure = DynamicTopology.from_edges(
+            spec.size, {e: 1.0 for e in spec.edges})
+        _structure_cache[key] = structure
+    return structure
+
+
+def class_recv_weights(spec: CommSpec) -> jnp.ndarray:
+    """[n_classes, n] weight rows: row c, entry d = the weight rank d
+    applies to what it receives through shift class c (0 where no edge).
+    Class order matches ``edge_structure(spec).shift_classes``.  Built in
+    float64 so f64 payloads (x64 mode) combine with exact weights; JAX
+    downcasts to f32 automatically when x64 is off.
+
+    For DynamicTopology the rows come straight from the edge-weight map
+    over the memoized skeleton's classes — the per-step spec itself is
+    never decomposed (eager hot path)."""
+    if isinstance(spec, Topology):
+        rows = [cls.recv_weights for cls in spec.shift_classes]
+        if not rows:
+            return jnp.zeros((0, spec.size), jnp.float32)
+        return jnp.asarray(np.asarray(rows, np.float64))
+    structure = edge_structure(spec)
+    ew = dict(zip(spec.edges, spec.edge_weight_values))
+    rows = np.zeros((len(structure.shift_classes), spec.size), np.float64)
+    for c, cls in enumerate(structure.shift_classes):
+        for (src, dst) in cls.perm:
+            rows[c, dst] = ew.get((src, dst), 0.0)
+    return jnp.asarray(rows)
+
+
+def self_weight_vector(spec: CommSpec) -> jnp.ndarray:
+    """[n] per-rank self weights as a traced-operand vector (float64 for
+    the same exactness reason as ``class_recv_weights``)."""
+    return jnp.asarray(np.asarray(_self_weights_of(spec), np.float64))
 
 
 def allreduce(x: jax.Array, axis_name: str, average: bool = True) -> jax.Array:
@@ -122,6 +176,8 @@ def neighbor_allreduce(
     spec: CommSpec,
     axis_name: str,
     compress: Optional[str] = None,
+    class_weights: Optional[jax.Array] = None,
+    self_weights: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Weighted neighbor averaging — THE BlueFog primitive.
 
@@ -138,26 +194,39 @@ def neighbor_allreduce(
     made TPU-native by riding the collective itself.  The self term stays
     full precision; max relative error per received tensor is
     ~0.4% of its absmax.
+
+    ``class_weights`` ([n_classes, n], ``class_recv_weights`` layout) and
+    ``self_weights`` ([n]) optionally supply the combine weights as TRACED
+    OPERANDS; ``spec`` then only contributes the edge structure, so one
+    compiled program serves every weight schedule over that structure
+    (eager retrace-hazard fix — same design as windows.py's put/update).
     """
     if compress not in (None, "int8"):
         raise ValueError(f"unknown compress mode {compress!r}")
     acc_dtype = _accum_dtype(x.dtype)
     idx = lax.axis_index(axis_name)
-    self_w = jnp.asarray(_self_weights_of(spec), dtype=acc_dtype)[idx]
+    if self_weights is None:
+        self_w = jnp.asarray(_self_weights_of(spec), dtype=acc_dtype)[idx]
+    else:
+        self_w = self_weights.astype(acc_dtype)[idx]
+
+    def recv_w(c, cls):
+        if class_weights is None:
+            return jnp.asarray(cls.recv_weights, dtype=acc_dtype)[idx]
+        return class_weights[c].astype(acc_dtype)[idx]
+
     received, weights = [], [self_w]
     if compress == "int8":
         q, scale = _wire_quantize_int8(x)
-        for cls in spec.shift_classes:
+        for c, cls in enumerate(spec.shift_classes):
             rq = lax.ppermute(q, axis_name, cls.perm)
             rs = lax.ppermute(scale, axis_name, cls.perm)
             received.append(rq.astype(jnp.float32) * rs)
-            weights.append(
-                jnp.asarray(cls.recv_weights, dtype=acc_dtype)[idx])
+            weights.append(recv_w(c, cls))
     else:
-        for cls in spec.shift_classes:
+        for c, cls in enumerate(spec.shift_classes):
             received.append(lax.ppermute(x, axis_name, cls.perm))
-            weights.append(
-                jnp.asarray(cls.recv_weights, dtype=acc_dtype)[idx])
+            weights.append(recv_w(c, cls))
     # The weighted combine is a plain multiply-add chain; XLA fuses it
     # into one HBM pass.  A hand-written Pallas kernel for this was
     # benchmarked on v5e (round 2) at 1.5-2.3x SLOWER than the XLA fusion
@@ -386,6 +455,8 @@ def hierarchical_neighbor_allreduce(
     machine_spec: CommSpec,
     local_size: int,
     axis_name: str,
+    class_weights: Optional[jax.Array] = None,
+    self_weights: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Machine-level neighbor averaging.
 
@@ -406,9 +477,13 @@ def hierarchical_neighbor_allreduce(
 
     idx = lax.axis_index(axis_name)
     machine_id = idx // local_size
-    self_w = jnp.asarray(_self_weights_of(machine_spec), dtype=acc_dtype)[machine_id]
+    if self_weights is None:
+        self_w = jnp.asarray(_self_weights_of(machine_spec),
+                             dtype=acc_dtype)[machine_id]
+    else:
+        self_w = self_weights.astype(acc_dtype)[machine_id]
     acc = local_mean * self_w
-    for cls in machine_spec.shift_classes:
+    for c, cls in enumerate(machine_spec.shift_classes):
         # Machine edge (ms, md) expands to rank pairs (ms*L+j, md*L+j).
         pairs = [
             (ms * local_size + j, md * local_size + j)
@@ -416,6 +491,9 @@ def hierarchical_neighbor_allreduce(
             for j in range(local_size)
         ]
         received = lax.ppermute(local_mean, axis_name, pairs)
-        w = jnp.asarray(cls.recv_weights, dtype=acc_dtype)[machine_id]
+        if class_weights is None:
+            w = jnp.asarray(cls.recv_weights, dtype=acc_dtype)[machine_id]
+        else:
+            w = class_weights[c].astype(acc_dtype)[machine_id]
         acc = acc + received * w
     return acc.astype(x.dtype)

@@ -15,6 +15,7 @@ This module is pure NumPy (host-side, trace-time) — nothing here touches jax.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -185,8 +186,11 @@ class DynamicTopology:
             self_weights = [0.0] * size
         return DynamicTopology(size, edges, vals, tuple(float(w) for w in self_weights))
 
-    @property
+    @functools.cached_property
     def shift_classes(self) -> Tuple[ShiftClass, ...]:
+        # cached_property writes through __dict__, which frozen
+        # dataclasses allow — the decomposition of an immutable edge set
+        # never changes, so repeated access (eager hot path) is O(1)
         ew = dict(zip(self.edges, self.edge_weight_values))
         return _decompose(self.size, self.edges, ew)
 

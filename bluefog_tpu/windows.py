@@ -41,6 +41,10 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bluefog_tpu.context import AXIS, BluefogContext, BluefogError, host_fetch
+from bluefog_tpu.parallel.collectives import (
+    class_recv_weights as _class_recv_weights,
+    edge_structure as _edge_structure,
+)
 from bluefog_tpu.topology.spec import DynamicTopology
 
 P_DTYPE = jnp.float64  # associated-P kept in f64 on CPU, f32 on TPU (below)
@@ -471,13 +475,6 @@ class WindowManager:
 # compiled program — so schedules that vary weights per step reuse one
 # compilation.
 # ------------------------------------------------------------------ #
-def _edge_structure(spec: DynamicTopology) -> DynamicTopology:
-    """The spec with all edge weights replaced by 1.0 — the compile-time
-    skeleton.  A DECLARED edge transfers even when its weight is 0.0
-    (matching the reference, which sends the scaled-by-zero payload,
-    mpi_controller.cc:594-600, rather than skipping the send)."""
-    return DynamicTopology.from_edges(
-        spec.size, {e: 1.0 for e in spec.edges})
 
 
 def _slot_tables(structure: DynamicTopology, in_lists) -> list:
@@ -497,17 +494,6 @@ def _slot_tables(structure: DynamicTopology, in_lists) -> list:
                 tbl.append(-1)
         tables.append(tuple(tbl))
     return tables
-
-
-def _class_recv_weights(spec: DynamicTopology) -> jnp.ndarray:
-    """[n_classes, n] f32: row c, entry d = the weight rank d applies to
-    what it receives through shift class c (0 where no edge).  Class
-    order matches ``_edge_structure(spec).shift_classes`` (both decompose
-    the same edge set, sorted by shift)."""
-    rows = [cls.recv_weights for cls in spec.shift_classes]
-    if not rows:
-        return jnp.zeros((0, spec.size), jnp.float32)
-    return jnp.asarray(np.asarray(rows, np.float32))
 
 
 def _put_kernel(x, mailbox, versions, p, p_mailbox, wvecs, self_weights,

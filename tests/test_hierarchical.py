@@ -90,6 +90,33 @@ def test_hier_neighbor_allreduce_dynamic_move(hier):
         np.testing.assert_allclose(host[r], expected, atol=1e-6)
 
 
+def test_hier_varying_dynamic_weights_do_not_recompile(hier):
+    """Round-2 verdict item 2, hierarchical flavor: varying machine-level
+    weight VALUES over one edge structure must reuse ONE compiled
+    program (weights are traced operands, not compile-cache keys)."""
+    from bluefog_tpu.context import get_context
+
+    n = bf.size()
+    m = bf.machine_size()
+    bf.set_machine_topology(RingGraph(m))
+    ctx = get_context()
+    x = bf.from_rank_values(lambda r: np.full((4,), float(r // LOCAL)))
+    cache_sizes = []
+    for step in range(20):
+        w = 1.0 / (2.0 + 0.61 * step)  # never repeats
+        out = bf.hierarchical_neighbor_allreduce(
+            x, self_weight=1.0 - w,
+            src_machine_weights=[{(mr + 1) % m: w} for mr in range(m)],
+            dst_machine_weights=[[(mr - 1) % m] for mr in range(m)])
+        host = np.asarray(out)
+        for r in range(n):
+            mr = r // LOCAL
+            expected = (1.0 - w) * mr + w * ((mr + 1) % m)
+            np.testing.assert_allclose(host[r], expected, atol=1e-6)
+        cache_sizes.append(len(ctx._op_cache))
+    assert cache_sizes[-1] == cache_sizes[0], cache_sizes
+
+
 def test_hier_requires_machine_topology(hier):
     from bluefog_tpu.context import BluefogError
 

@@ -192,6 +192,39 @@ def test_neighbor_allreduce_dynamic_empty_send(bf_ctx):
         np.testing.assert_allclose(out[r], r, atol=1e-12)
 
 
+def test_varying_dynamic_weights_do_not_recompile(bf_ctx):
+    """Round-2 verdict item 2 regression: eager dynamic-mode
+    neighbor_allreduce used to key its compile cache on the weight
+    VALUES (DynamicTopology.digest hashes them), so a schedule with
+    continuously-varying weights — e.g. decaying averaging weights via
+    the reference's mutable opt.src_weights knobs (reference
+    optimizers.py:326-331) — compiled a new program every step.  Weights
+    are traced operands now: 50 rounds x 50 different weight sets over
+    one edge structure -> ONE cached program, and every round's combine
+    still uses its own weights."""
+    from bluefog_tpu.context import get_context
+
+    ctx = get_context()
+    shift = 1
+    x = rank_tensor((3,), np.float64)
+    cache_sizes = []
+    for step in range(50):
+        w = 1.0 / (2.0 + 0.37 * step)  # never repeats
+        out = bf.neighbor_allreduce(
+            x, self_weight=1.0 - w,
+            src_weights=[{(r - shift) % SIZE: w} for r in range(SIZE)],
+            dst_weights=[[(r + shift) % SIZE] for r in range(SIZE)])
+        expected = [(1.0 - w) * r + w * ((r - shift) % SIZE)
+                    for r in range(SIZE)]
+        # rtol=0: f64 payloads must combine with EXACT f64 weights (the
+        # traced weight operands are f64, not f32-rounded)
+        np.testing.assert_allclose(
+            np.asarray(out)[:, 0], expected, rtol=0, atol=1e-12)
+        cache_sizes.append(len(ctx._op_cache))
+    assert cache_sizes[-1] == cache_sizes[0], (
+        f"compile cache grew per step: {cache_sizes[:5]}...")
+
+
 def test_neighbor_allreduce_topo_check(bf_ctx):
     """enable_topo_check rejects one-sided edge declarations (reference
     mpi_controller.cc:364-417 CheckNeighborSendRecvPattern)."""
