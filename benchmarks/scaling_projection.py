@@ -1,0 +1,293 @@
+"""Scaling-efficiency projection — turns the structural O(1)-communication
+guarantee into a number (round-2 verdict item 3).
+
+Method
+------
+1. Compile the REAL batch-128 ResNet-50 train step (bench.py's exact
+   configuration) over n-device meshes for each distributed optimizer and
+   extract the per-step collective payload bytes from the optimized HLO
+   (``bluefog_tpu.benchutil.hlo_collective_bytes``) — machine-checked, not
+   hand-derived.
+2. Cross-check the extracted bytes against the analytic model (one-peer
+   dynamic = 1x params; static exp2 = log2(n)x params; ring allreduce =
+   1x grads entering a 2(n-1)/n-cost ring).
+3. Combine with the measured single-chip step time and v5e ICI bandwidth
+   into projected scaling efficiency at 16/64/128 chips, under stated
+   assumptions (below).
+
+Assumptions (all surfaced in the JSON):
+* Single-chip compute time from BENCH (46.9 ms at batch 128 on v5e-1,
+  overridable with --step-ms); compute time per chip is n-independent
+  (pure DP — each chip's FLOPs never change with n).
+* ICI: v5e publishes 1600 Gbps/chip total interconnect; the conservative
+  per-link one-way figure used here is 1600/8 = 200 Gbps = 25 GB/s
+  (4 links x 2 directions).  --ici-gbps sets the per-link one-way rate.
+* A collective-permute moves its payload at one link's one-way bandwidth
+  (the one-peer schedule's 2^k logical shifts are assumed torus-routable
+  without link sharing — XLA's ICI mapping; the hop-dilated pessimistic
+  variant is also reported with hops = min(2^k, n - 2^k) averaged over
+  the schedule).
+* Ring all-reduce wire cost: 2(n-1)/n x payload at one link's one-way
+  bandwidth (XLA's bidirectional ring halves wall time but doubles link
+  use; the net is the same under link-limited accounting).
+* No compute/comm overlap (conservative): efficiency = t1 / (t1 + tc).
+  The full-overlap bound max(t1, tc) is also reported.
+
+Run (CPU, no TPU needed): python benchmarks/scaling_projection.py
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=32")
+os.environ["JAX_PLATFORMS"] = "cpu"  # compile-only harness; never the TPU
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from bluefog_tpu import models  # noqa: E402
+from bluefog_tpu.benchutil import hlo_collective_bytes  # noqa: E402
+from bluefog_tpu.optim import functional as F  # noqa: E402
+from bluefog_tpu.topology import (  # noqa: E402
+    ExponentialTwoGraph,
+    one_peer_dynamic_schedule,
+    uniform_topology_spec,
+)
+
+BATCH = 128
+MODES = ("dynamic", "neighbor_allreduce", "horovod")
+
+
+def build_step(n, mode, compress=None):
+    mesh = Mesh(np.array(jax.devices()[:n]), ("bf",))
+    model = models.ResNet50(num_classes=1000)
+
+    def loss_fn(params, aux, batch):
+        x, y = batch
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": aux}, x, train=True,
+            mutable=["batch_stats"])
+        return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+            logits, y)), updates["batch_stats"]
+
+    kwargs = {}
+    if mode == "dynamic":
+        kwargs = dict(schedule=one_peer_dynamic_schedule(n), comm_mode="atc")
+    elif mode == "neighbor_allreduce":
+        kwargs = dict(topology=uniform_topology_spec(ExponentialTwoGraph(n)),
+                      comm_mode="atc")
+    else:
+        kwargs = dict(comm_mode="gradient_allreduce")
+    if compress:
+        kwargs["compress"] = compress
+    opt = optax.sgd(0.1, momentum=0.9)
+    step_fn = F.build_train_step(loss_fn, opt, mesh, has_aux=True, **kwargs)
+
+    variables = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0),
+        jnp.ones((BATCH, 224, 224, 3), jnp.bfloat16))
+    shapes = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct((n,) + s.shape, s.dtype), variables)
+    params = shapes["params"]
+    aux = shapes["batch_stats"]
+    opt_state = jax.eval_shape(
+        lambda: opt.init(jax.tree.map(
+            lambda s: jnp.zeros(s.shape[1:], s.dtype), params)))
+    opt_state = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct((n,) + s.shape, s.dtype), opt_state)
+    batch = (jax.ShapeDtypeStruct((n, BATCH, 224, 224, 3), jnp.bfloat16),
+             jax.ShapeDtypeStruct((n, BATCH), jnp.int32))
+    step = jax.ShapeDtypeStruct((), jnp.int32)
+    return step_fn, (params, aux, opt_state, batch, step)
+
+
+def extract(n, mode, compress=None):
+    """Per-step collective bytes of the compiled train step."""
+    step_fn, abstract_args = build_step(n, mode, compress)
+    n_leaves = len(jax.tree.leaves(abstract_args[0]))
+    hlo = jax.jit(step_fn).lower(*abstract_args).compile().as_text()
+    per_kind = hlo_collective_bytes(hlo)
+    n_branches = len(one_peer_dynamic_schedule(n)) if mode == "dynamic" else 1
+    total_bytes = sum(r["bytes"] for r in per_kind.values())
+    permutes = per_kind.get("collective-permute", {"count": 0, "bytes": 0})
+    return {
+        "mode": mode, "n": n, "compress": compress,
+        "param_leaves": n_leaves,
+        "per_kind": per_kind,
+        "switch_branches": n_branches,
+        "per_step_bytes": total_bytes / n_branches,
+        "per_step_permutes": permutes["count"] / n_branches,
+    }
+
+
+def project(per_step_bytes, mode, n, step_ms, link_gbps, hop_factor=1.0):
+    bw = link_gbps * 1e9 / 8  # bytes/s one-way per link
+    wire = per_step_bytes * hop_factor
+    if mode == "horovod":
+        wire *= 2.0 * (n - 1) / n  # ring allreduce wire cost
+    tc_ms = wire / bw * 1e3
+    t1 = step_ms
+    return {
+        "comm_ms": round(tc_ms, 3),
+        "efficiency_no_overlap": round(t1 / (t1 + tc_ms), 4),
+        "efficiency_full_overlap": round(t1 / max(t1, tc_ms), 4),
+    }
+
+
+def mean_hops(n):
+    """Average torus-hop dilation of the one-peer exp2 schedule, assuming
+    the logical rank ring embeds on the ICI torus so a 2^k shift costs
+    min(2^k, n-2^k) nearest-neighbor hops in the worst mapping."""
+    shifts = [2 ** k for k in range(int(np.log2(n)))]
+    return float(np.mean([min(s, n - s) for s in shifts]))
+
+
+def _target_conditions(projections, big, step_ms, link_gbps):
+    """Which stated conditions make the one-peer dynamic schedule reach
+    >=95% at the largest projected size — the honest form of the claim."""
+    tc = projections[big]["dynamic"]["comm_ms"]
+    # exposed comm budget for 95%: t1 (1/0.95 - 1)
+    budget_ms = step_ms * (1 / 0.95 - 1)
+    overlap_needed = max(0.0, 1.0 - budget_ms / tc)
+    bw_needed = link_gbps * tc / budget_ms
+    return {
+        "int8_wire_compression": bool(
+            projections[big]["dynamic_int8_wire"]
+            ["efficiency_no_overlap"] >= 0.95),
+        "or_min_comm_compute_overlap": round(overlap_needed, 3),
+        "or_min_per_link_oneway_gbps": round(bw_needed, 1),
+        "note": "any ONE of these suffices; with zero overlap, "
+                "uncompressed f32 params, and the conservative "
+                f"{link_gbps:.0f} Gbps/link figure the projection is "
+                f"{projections[big]['dynamic']['efficiency_no_overlap']}",
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--step-ms", type=float, default=46.9,
+                    help="measured single-chip step time (bench.py, b128)")
+    ap.add_argument("--ici-gbps", type=float, default=200.0,
+                    help="per-link one-way ICI rate (v5e: 1600/8)")
+    ap.add_argument("--sizes", default="8,16,32",
+                    help="mesh sizes to compile and extract HLO from")
+    ap.add_argument("--project-sizes", default="16,64,128")
+    ap.add_argument("--out", default="benchmarks/scaling_projection_r03.json")
+    args = ap.parse_args()
+
+    compile_sizes = [int(s) for s in args.sizes.split(",")]
+    n_dev = len(jax.devices())
+    if max(compile_sizes) > n_dev:
+        raise SystemExit(
+            f"--sizes max {max(compile_sizes)} exceeds the {n_dev} forced "
+            "host devices (raise the count at the top of this script)")
+    extracted = []
+    for mode in MODES:
+        for n in compile_sizes:
+            rec = extract(n, mode)
+            extracted.append(rec)
+            print(f"[extract] {mode:<20} n={n:<3} "
+                  f"permutes/step={rec['per_step_permutes']:.0f} "
+                  f"bytes/step={rec['per_step_bytes']/1e6:.1f} MB",
+                  file=sys.stderr)
+    comp = extract(compile_sizes[-1], "dynamic", compress="int8")
+    extracted.append(comp)
+    print(f"[extract] dynamic+int8        n={comp['n']:<3} "
+          f"bytes/step={comp['per_step_bytes']/1e6:.1f} MB", file=sys.stderr)
+
+    # Analytic cross-check at the largest compiled size: the dynamic
+    # one-peer step must move ~1x the f32 parameter bytes, the static
+    # exp2 step log2(n)x.  (Allow 5% slack for the loss/stats scalars.)
+    pbytes = 25_557_032 * 4  # ResNet-50 f32 params
+    dyn = next(r for r in extracted
+               if r["mode"] == "dynamic" and r["n"] == compile_sizes[-1]
+               and not r["compress"])
+    stat = next(r for r in extracted
+                if r["mode"] == "neighbor_allreduce"
+                and r["n"] == compile_sizes[-1])
+    checks = {
+        # one parameter-size transmit per step (README.rst:51-60 claim)
+        "dynamic_bytes_eq_params": abs(dyn["per_step_bytes"] / pbytes - 1)
+        < 0.05,
+        # one logical exchange per step = one permute per param leaf
+        # (the whole-pytree combine lowers leaf-wise)
+        "dynamic_one_exchange_per_step":
+        dyn["per_step_permutes"] == dyn["param_leaves"],
+        "static_exp2_bytes_eq_logn_params":
+        abs(stat["per_step_bytes"]
+            / (pbytes * np.log2(compile_sizes[-1])) - 1) < 0.05,
+    }
+    hvd = next(r for r in extracted
+               if r["mode"] == "horovod" and r["n"] == compile_sizes[-1])
+    # ring allreduce enters with 1x the f32 gradient bytes (the 2(n-1)/n
+    # wire factor is the ring algorithm's, applied in project())
+    checks["horovod_bytes_eq_grads"] = \
+        abs(hvd["per_step_bytes"] / pbytes - 1) < 0.05
+    checks = {k: bool(v) for k, v in checks.items()}  # np.bool_ -> json
+    for name, ok in checks.items():
+        print(f"[check] {name}: {'OK' if ok else 'FAILED'}", file=sys.stderr)
+
+    project_sizes = [int(s) for s in args.project_sizes.split(",")]
+    big = str(max(project_sizes))
+    projections = {}
+    for n in project_sizes:
+        per_mode = {}
+        for mode in MODES:
+            bytes_n = pbytes * (np.log2(n) if mode == "neighbor_allreduce"
+                                else 1.0)
+            per_mode[mode] = project(bytes_n, mode, n, args.step_ms,
+                                     args.ici_gbps)
+        per_mode["dynamic_int8_wire"] = project(
+            comp["per_step_bytes"], "dynamic", n, args.step_ms,
+            args.ici_gbps)
+        per_mode["dynamic_hop_dilated"] = project(
+            pbytes, "dynamic", n, args.step_ms, args.ici_gbps,
+            hop_factor=mean_hops(n))
+        projections[str(n)] = per_mode
+
+    result = {
+        "method": "HLO-extracted per-step collective bytes x measured "
+                  "single-chip step time x v5e ICI bandwidth",
+        "assumptions": {
+            "single_chip_step_ms": args.step_ms,
+            "batch_per_chip": BATCH,
+            "ici_per_link_oneway_gbps": args.ici_gbps,
+            "ici_note": "v5e total interconnect 1600 Gbps/chip; per-link "
+                        "one-way = 1600/8.  Permutes assumed torus-routed "
+                        "at full link rate (see dynamic_hop_dilated for "
+                        "the pessimistic bound).",
+            "overlap": "efficiency_no_overlap assumes zero compute/comm "
+                       "overlap; efficiency_full_overlap is the bound "
+                       "with perfect overlap",
+            "ring_allreduce_wire_cost": "2(n-1)/n x payload",
+            "resnet50_param_bytes_f32": pbytes,
+        },
+        "hlo_extraction": extracted,
+        "analytic_cross_checks": checks,
+        "projected_efficiency": projections,
+        "north_star": {
+            "target": ">=95% scaling efficiency at v5e-128 "
+                      "(BASELINE.md)",
+            f"one_peer_dynamic_at_{big}":
+            projections[big]["dynamic"]["efficiency_no_overlap"],
+            f"one_peer_dynamic_int8_at_{big}":
+            projections[big]["dynamic_int8_wire"]["efficiency_no_overlap"],
+            f"ring_allreduce_at_{big}":
+            projections[big]["horovod"]["efficiency_no_overlap"],
+            "conditions_for_target": _target_conditions(
+                projections, big, args.step_ms, args.ici_gbps),
+        },
+    }
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result["north_star"], indent=1))
+
+
+if __name__ == "__main__":
+    main()

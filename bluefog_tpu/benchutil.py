@@ -10,13 +10,15 @@ copy and measures ~0.
 
 from __future__ import annotations
 
+import re
 import time
 
 import jax
 import numpy as np
 
 __all__ = ["device_fetch", "fetch_overhead", "timed",
-           "chip_peak_flops", "compiled_step_flops", "mfu"]
+           "chip_peak_flops", "compiled_step_flops", "mfu",
+           "hlo_collective_bytes"]
 
 # Dense bf16 peak FLOP/s per chip, from published TPU specs.  Keyed by
 # substrings of jax's ``device_kind``; override with BLUEFOG_CHIP_PEAK_TFLOPS
@@ -75,6 +77,54 @@ def mfu(flops_per_step: float, step_seconds: float,
     if not peak_per_chip or step_seconds <= 0:
         return 0.0
     return flops_per_step / step_seconds / peak_per_chip
+
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+# one HLO collective instruction: `%name = TYPE op-name(%operand, ...)` —
+# optimized HLO prints operands as bare names, so the payload shape is the
+# RESULT type to the left of the op name (tuple types for fused/async ops)
+_COLLECTIVE_RE = re.compile(
+    r"=\s*(?P<types>[^=]*?)\s*\b(?P<op>collective-permute|all-reduce|"
+    r"all-gather|reduce-scatter|all-to-all)(?P<suffix>-start|-done)?\(")
+_SHAPE_RE = re.compile(r"\b(pred|[sub]8|[sufb]\d+|bf16)\[([0-9,]*)\]")
+
+
+def hlo_collective_bytes(hlo_text: str) -> dict:
+    """Per-collective-kind payload bytes of one execution of an optimized
+    HLO module: ``{kind: {"count": n_instructions, "bytes": sum}}``.
+
+    Bytes come from each collective's result type — the PER-DEVICE shard
+    payload (tuple results summed; async ``-start`` skipped and counted
+    at the matching ``-done`` so pairs are not double-counted).  For
+    all-gather the result is the gathered buffer, an upper bound within
+    (n-1)/n of the wire bytes.  Collectives inside ``conditional``
+    branches (``lax.switch`` dynamic schedules) are all present in the
+    module text but only one branch executes per step — callers divide by
+    the branch count for per-step figures."""
+    out: dict = {}
+    # tuple types are printed with /*index=N*/ comments whose '=' would
+    # truncate the types capture — strip them first
+    hlo_text = re.sub(r"/\*.*?\*/", "", hlo_text)
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        if m.group("suffix") == "-start":
+            continue
+        kind = m.group("op")
+        nbytes = 0
+        for sm in _SHAPE_RE.finditer(m.group("types")):
+            dt, dims = sm.group(1), sm.group(2)
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes += n * _DTYPE_BYTES.get(dt, 4)
+        rec = out.setdefault(kind, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += nbytes
+    return out
 
 
 def device_fetch(a) -> np.ndarray:

@@ -1,10 +1,12 @@
 """Framework adapters.
 
-The reference maintains a second framework binding beside torch (its
-TensorFlow custom ops + DistributedOptimizer, reference
-bluefog/tensorflow/).  The TPU build's second surface is a **PyTorch
-bridge**: torch tensors in, torch tensors out, with the JAX/XLA data plane
-underneath (host round-trip through numpy).
+The reference binds two frameworks (torch + TensorFlow custom ops,
+reference bluefog/torch/, bluefog/tensorflow/).  The TPU build's primary
+surface is JAX; BOTH a **PyTorch bridge** and a **TensorFlow bridge**
+are provided (framework tensors in/out, the JAX/XLA data plane
+underneath, one numpy host round-trip each way).  The torch names are
+re-exported flat for compatibility; the TF surface lives under
+``interop.tf`` / ``bluefog_tpu.interop.tf_adapter``.
 """
 
 from bluefog_tpu.interop.torch_adapter import (  # noqa: F401
@@ -16,6 +18,22 @@ from bluefog_tpu.interop.torch_adapter import (  # noqa: F401
     broadcast_parameters,
     neighbor_allreduce,
 )
+
+
+def __getattr__(name):
+    # PEP 562 lazy import: touching interop.tf / TFAdapter is what pays
+    # TensorFlow's multi-second import, not `import bluefog_tpu.interop`.
+    # importlib (not `from ... import`) avoids re-entering this hook.
+    if name in ("tf", "tf_adapter"):
+        import importlib
+
+        return importlib.import_module("bluefog_tpu.interop.tf_adapter")
+    if name == "TFAdapter":
+        import importlib
+
+        mod = importlib.import_module("bluefog_tpu.interop.tf_adapter")
+        return mod.TFAdapter
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from bluefog_tpu.interop.hf_llama import (  # noqa: F401
     llama_config_from_hf,
     llama_params_from_hf,

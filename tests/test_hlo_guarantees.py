@@ -184,3 +184,33 @@ def test_allreduce_baseline_uses_no_permute_but_psum(mesh):
     hlo = _compiled_hlo(sm, jnp.zeros((N, 16), jnp.float32))
     assert _count_permutes(hlo) == 0
     assert "all-reduce" in hlo
+
+
+def test_hlo_collective_bytes_extraction(mesh):
+    """The scaling-projection harness's byte extractor
+    (benchutil.hlo_collective_bytes) reads per-device payloads out of
+    compiled HLO: one permute per shift class carrying the f32 shard, and
+    the tuple-fused all-reduce counted with every element (the printed
+    /*index=N*/ comments must not truncate the tuple)."""
+    from bluefog_tpu.benchutil import hlo_collective_bytes
+
+    spec = uniform_topology_spec(graphs.ExponentialTwoGraph(N))
+
+    def combine(x, y):
+        out = C.neighbor_allreduce(x, spec, "bf")
+        # two leaves psum'd together -> one fused tuple all-reduce
+        return out, C.allreduce(x, "bf") + 0.0 * out, C.allreduce(y, "bf")
+
+    sm = jax.shard_map(combine, mesh=mesh,
+                       in_specs=(P("bf"), P("bf")),
+                       out_specs=(P("bf"), P("bf"), P("bf")),
+                       check_vma=False)
+    hlo = _compiled_hlo(sm, jnp.zeros((N, 64), jnp.float32),
+                        jnp.zeros((N, 96), jnp.float32))
+    got = hlo_collective_bytes(hlo)
+    shard_bytes = 64 * 4
+    assert got["collective-permute"]["count"] == int(np.log2(N))
+    assert got["collective-permute"]["bytes"] == \
+        int(np.log2(N)) * shard_bytes
+    # both psums present with full payload regardless of fusion layout
+    assert got["all-reduce"]["bytes"] == 64 * 4 + 96 * 4
