@@ -44,7 +44,14 @@ def main():
     n = len(devices)
     mesh = Mesh(np.array(devices), ("bf",))
 
-    model = models.ResNet50(num_classes=1000)  # bf16 compute, f32 params
+    import os
+
+    # bf16 compute, f32 params; BLUEFOG_BENCH_PALLAS_CONV1X1=1 routes the
+    # bottleneck 1x1s through the fused Pallas backward for A/B runs
+    model = models.ResNet50(
+        num_classes=1000,
+        pallas_conv1x1=os.environ.get(
+            "BLUEFOG_BENCH_PALLAS_CONV1X1", "0") == "1")
 
     def loss_fn(params, aux, batch):
         images, labels = batch

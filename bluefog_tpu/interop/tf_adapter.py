@@ -9,6 +9,11 @@ migrates onto.  Like the torch bridge, it accepts **rank-major tensors**
 (``[n_ranks, ...]``, host-resident) and converts through numpy; the
 jitted JAX path remains the performance surface.
 
+EAGER-ONLY: every op bridges through host numpy, so none of this
+surface works inside ``tf.function`` / Keras ``model.fit`` graph
+tracing (use ``run_eagerly=True`` there, or the JAX-native API for
+compiled paths) — the guard in ``_to_jax`` raises with this message.
+
 Gradient flow matches the reference's registered gradients:
 ``allreduce``'s gradient is an allreduce (reference mpi_ops.py:95-106),
 ``broadcast``'s is a reduction onto the root (reference :163-178), and
@@ -47,6 +52,16 @@ def _to_jax(tensor):
     import jax
 
     _require_tf()
+    if not tf.executing_eagerly():
+        # symbolic tensors have no .numpy(); the host numpy bridge is
+        # inherently eager (same restriction class as BLUEFOG_OPS_ON_CPU
+        # staging in the reference) — fail with the reason, not an
+        # AttributeError deep inside
+        raise RuntimeError(
+            "bluefog_tpu.interop.tf_adapter is EAGER-ONLY: its ops bridge "
+            "through host numpy and cannot run inside tf.function / "
+            "Keras model.fit graphs. Call them eagerly (run_eagerly=True "
+            "for Keras) or use the JAX-native API for compiled paths.")
     if not tf.is_tensor(tensor):
         tensor = tf.convert_to_tensor(tensor)
     if (tensor.dtype in (tf.float64, tf.int64)

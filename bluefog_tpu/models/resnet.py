@@ -26,6 +26,28 @@ from jax import lax
 ModuleDef = Any
 
 
+class _PallasConv1x1(nn.Module):
+    """1x1 conv whose backward is the Pallas fused dgrad+wgrad kernel
+    (parallel/pallas_conv.py) — one pass over x and dy instead of XLA's
+    two separate transposed convolutions.  Parameter layout stays
+    ``kernel [1, 1, ci, co]`` so checkpoints interchange with nn.Conv."""
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from bluefog_tpu.parallel.pallas_conv import conv1x1
+
+        ci = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (1, 1, ci, self.features), jnp.float32)
+        return conv1x1(x.astype(self.dtype),
+                       kernel.reshape(ci, self.features).astype(self.dtype),
+                       self.strides[0])
+
+
 class _SpaceToDepthInit(nn.Module):
     """The stem 7x7/s2 conv, computed space-to-depth (MLPerf ResNet
     trick): 3 input channels use 3/128 of the MXU's reduction depth, so
@@ -84,9 +106,13 @@ class BottleneckBlock(nn.Module):
     strides: Tuple[int, int]
     conv: ModuleDef
     norm: ModuleDef
+    conv1x1: ModuleDef = None  # expansion/proj 1x1s (Pallas bwd) if set
 
     @nn.compact
     def __call__(self, x):
+        expand = self.conv1x1 or (
+            lambda f, s=(1, 1), name=None: self.conv(f, (1, 1), s,
+                                                     name=name))
         residual = x
         y = self.conv(self.filters, (1, 1))(x)
         y = self.norm()(y)
@@ -94,12 +120,12 @@ class BottleneckBlock(nn.Module):
         y = self.conv(self.filters, (3, 3), self.strides)(y)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = expand(self.filters * 4)(y)
         # zero-init the last BN scale so each block starts as identity
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
-            residual = self.conv(self.filters * 4, (1, 1), self.strides,
-                                 name="conv_proj")(residual)
+            residual = expand(self.filters * 4, self.strides,
+                              name="conv_proj")(residual)
             residual = self.norm(name="norm_proj")(residual)
         return nn.relu(residual + y)
 
@@ -114,6 +140,11 @@ class ResNet(nn.Module):
     # measurably faster on the MXU (see _SpaceToDepthInit); disable only
     # for odd input sizes (needs H and W divisible by 2)
     space_to_depth: bool = True
+    # Route the bottleneck expansion/projection 1x1 convs through the
+    # Pallas fused-backward kernel (parallel/pallas_conv.py).  Numerics
+    # match XLA (tests/test_pallas_conv.py); module auto-names differ
+    # from the nn.Conv layout, so flip it only on fresh params.
+    pallas_conv1x1: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -132,11 +163,15 @@ class ResNet(nn.Module):
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block_kwargs = {}
+        if self.pallas_conv1x1 and self.block_cls is BottleneckBlock:
+            block_kwargs["conv1x1"] = partial(_PallasConv1x1,
+                                              dtype=self.dtype)
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 x = self.block_cls(self.num_filters * 2**i, strides=strides,
-                                   conv=conv, norm=norm)(x)
+                                   conv=conv, norm=norm, **block_kwargs)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32,
                      param_dtype=jnp.float32)(x)

@@ -172,6 +172,173 @@ def test_two_process_hierarchical_machine_ops(tmp_path):
     assert out.stdout.count("hier OK") == 2, out.stdout
 
 
+def test_four_process_window_gossip(tmp_path):
+    """4 processes x 2 devices (world 8): the one-sided window family —
+    win_put/win_update consensus AND associated-P push-sum with the
+    sum(p) == n invariant — runs across real process boundaries
+    (round-2 verdict item 4; reference torch_win_ops_test.py:780-863)."""
+    script = tmp_path / "win.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import bluefog_tpu as bf
+        import jax
+
+        bf.init()
+        n = bf.size()
+        assert jax.process_count() == 4 and n == 8
+
+        # win_put / win_update consensus
+        x = bf.from_rank_values(lambda r: np.full((3,), float(r)))
+        bf.win_create(x, "w4")
+        for _ in range(30):
+            bf.win_put(x, "w4")
+            x = bf.win_update("w4")
+        vals = np.stack(bf.to_rank_values(x))
+        np.testing.assert_allclose(vals, (n - 1) / 2, atol=1e-3)
+        bf.win_free("w4")
+
+        # associated-P push-sum: sum of p stays n, debiased values agree
+        bf.turn_on_win_ops_with_associated_p()
+        try:
+            y = bf.from_rank_values(lambda r: np.full((2,), float(2 * r)))
+            bf.win_create(y, "ps4", zero_init=True)
+            graph = bf.load_topology()
+            out_n = {r: sorted(d for d in graph.successors(r) if d != r)
+                     for r in range(n)}
+            value = y
+            for _ in range(40):
+                a = {r: 1.0 / (len(out_n[r]) + 1) for r in range(n)}
+                bf.win_accumulate(
+                    value, "ps4",
+                    self_weight=[a[r] for r in range(n)],
+                    dst_weights=[{d: a[r] for d in out_n[r]}
+                                 for r in range(n)])
+                value = bf.win_update_then_collect("ps4")
+            ps = np.array([bf.win_associated_p("ps4", rank=r)
+                           for r in range(n)])
+            np.testing.assert_allclose(ps.sum(), n, rtol=1e-6)
+            debiased = np.stack(bf.to_rank_values(value)) / ps[:, None]
+            np.testing.assert_allclose(debiased, n - 1, atol=1e-3)
+            bf.win_free("ps4")
+        finally:
+            bf.turn_off_win_ops_with_associated_p()
+        print(f"proc {jax.process_index()} windows OK")
+    """))
+    port = _free_port()
+    out = _bfrun("-np", "4", "--force-cpu-devices", "2",
+                 "--coordinator", f"127.0.0.1:{port}",
+                 sys.executable, str(script))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("windows OK") == 4, out.stdout
+
+
+def test_four_process_ragged_neighbor_allgather(tmp_path):
+    """Ragged (non-uniform in-degree) neighbor_allgather across 4
+    processes: exercises the host_fetch -> process_allgather finalize
+    (context.py:245-255) that was single-process-only-tested before."""
+    script = tmp_path / "ragged.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        import bluefog_tpu as bf
+        import jax
+        from bluefog_tpu.topology import StarGraph
+
+        bf.init(topology_fn=StarGraph)
+        n = bf.size()
+        assert jax.process_count() == 4 and n == 8
+        x = bf.from_rank_values(
+            lambda r: np.full((2,), float(r), np.float64))
+        out = bf.neighbor_allgather(x)
+        # star: center 0 gathers every leaf (in-degree 7), leaves gather
+        # only the center (in-degree 1) -> ragged per-rank list
+        assert isinstance(out, list) and len(out) == n
+        np.testing.assert_array_equal(
+            np.asarray(out[0]).reshape(n - 1, 2),
+            np.stack([np.full((2,), float(r)) for r in range(1, n)]))
+        for r in range(1, n):
+            np.testing.assert_array_equal(np.asarray(out[r]),
+                                          np.zeros((2,)))
+        print(f"proc {jax.process_index()} ragged OK")
+    """))
+    port = _free_port()
+    out = _bfrun("-np", "4", "--force-cpu-devices", "2",
+                 "--coordinator", f"127.0.0.1:{port}",
+                 sys.executable, str(script))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("ragged OK") == 4, out.stdout
+
+
+def test_four_process_stall_attribution_names_dead_rank(tmp_path):
+    """SIGKILL one process mid-job: the SURVIVORS' stall watchdog must
+    name the dead process from its stale heartbeat (reference
+    operations.cc:388-433 prints the missing ranks).  Processes are
+    spawned directly (not via bfrun) so the launcher's fail-fast
+    teardown does not reap the survivors before the watchdog fires."""
+    import signal
+    import time as _time
+
+    script = tmp_path / "stall.py"
+    script.write_text(textwrap.dedent("""
+        import os, signal, threading, time
+        import numpy as np
+        import bluefog_tpu as bf
+        import jax
+
+        bf.init()
+        n = bf.size()
+        me = jax.process_index()
+        # a successful collective first: everyone is up, beacons beating
+        x = bf.from_rank_values(lambda r: np.full((2,), float(r)))
+        np.asarray(bf.to_rank_values(bf.allreduce(x))[0])
+        time.sleep(2.0)  # a couple of heartbeats of history
+        if me == 2:
+            os.kill(os.getpid(), signal.SIGKILL)
+        # survivors: hard exit after the watchdog has had time to fire
+        # (the collective below blocks forever on the dead rank)
+        threading.Timer(25.0, lambda: os._exit(0)).start()
+        bf.allreduce(x, name="post_kill_allreduce")
+    """))
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("XLA_", "JAX_"))}
+    env.update(PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               BLUEFOG_TPU_COORDINATOR=f"127.0.0.1:{port}",
+               BLUEFOG_TPU_NUM_PROCESSES="4",
+               BLUEFOG_STALL_WARNING_TIME="2")
+    children, logs = [], []
+    try:
+        for pid in range(4):
+            log = open(tmp_path / f"rank{pid}.err", "w+")
+            logs.append(log)
+            children.append(subprocess.Popen(
+                [sys.executable, str(script)],
+                env=dict(env, BLUEFOG_TPU_PROCESS_ID=str(pid)),
+                stdout=subprocess.DEVNULL, stderr=log, cwd=REPO))
+        deadline = _time.time() + 120
+        named = ""
+        while _time.time() < deadline and not named:
+            _time.sleep(2.0)
+            for pid in (0, 1, 3):
+                text = (tmp_path / f"rank{pid}.err").read_text()
+                if "missing process(es) [2]" in text:
+                    named = f"rank {pid} attributed: found in rank{pid}.err"
+                    break
+            if all(c.poll() is not None for c in children):
+                break
+        assert named, "no survivor named dead process 2; logs:\n" + \
+            "\n".join((tmp_path / f"rank{p}.err").read_text()[-800:]
+                      for p in (0, 1, 3))
+    finally:
+        for c in children:
+            if c.poll() is None:
+                c.send_signal(signal.SIGKILL)
+        for c in children:
+            c.wait()
+        for log in logs:
+            log.close()
+
+
 def test_ibfrun_engine_wiring(tmp_path, monkeypatch):
     """ibfrun's engines receive the same BLUEFOG_TPU_* contract as bfrun
     children (the wiring that makes `%%px bf.init()` form the job), and

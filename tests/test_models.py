@@ -245,6 +245,49 @@ def test_llama3_8b_flagship_traces():
     assert tuple(out_shape.shape) == (1, 2048, cfg.vocab_size)
 
 
+def test_resnet_pallas_conv1x1_grad_parity():
+    """pallas_conv1x1=True (fused Pallas backward for the bottleneck
+    expansion/projection 1x1s) must match the nn.Conv model's loss and
+    gradients — same math, different schedule."""
+    import optax
+
+    kw = dict(num_classes=10, dtype=jnp.float32, stage_sizes=(1, 1),
+              block_cls=models.resnet.BottleneckBlock, num_filters=8)
+    m_ref = models.ResNet(**kw)
+    m_pl = models.ResNet(**kw, pallas_conv1x1=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    y = jnp.array([1, 3])
+    v_ref = m_ref.init(jax.random.PRNGKey(1), x)
+    v_pl = m_pl.init(jax.random.PRNGKey(1), x)
+    # same number/shape of params, different module auto-names
+    ref_leaves = jax.tree.leaves(v_ref["params"])
+    pl_leaves = jax.tree.leaves(v_pl["params"])
+    assert [p.shape for p in ref_leaves] == [p.shape for p in pl_leaves]
+
+    def loss(model, variables):
+        def f(params, xx):
+            logits, _ = model.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                xx, train=True, mutable=["batch_stats"])
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(logits, y))
+
+        l, (gp, gx) = jax.value_and_grad(f, argnums=(0, 1))(
+            variables["params"], x)
+        return l, gp, gx
+
+    # seed-identical init -> identical math; module auto-names (and so
+    # tree leaf ORDER) differ, so compare the loss, the input gradient
+    # (whole backward chain), and the global param-grad norm
+    l_ref, gp_ref, gx_ref = loss(m_ref, v_ref)
+    l_pl, gp_pl, gx_pl = loss(m_pl, v_pl)
+    np.testing.assert_allclose(float(l_ref), float(l_pl), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx_ref), np.asarray(gx_pl),
+                               rtol=2e-4, atol=2e-5)
+    norm = lambda g: float(optax.global_norm(g))
+    np.testing.assert_allclose(norm(gp_ref), norm(gp_pl), rtol=1e-4)
+
+
 def test_resnet_space_to_depth_stem_matches_plain_conv():
     """Pins the space-to-depth re-indexing invariant: the 4x4/s1 conv over
     the 2x2-space-to-depth layout equals the plain 7x7/s2 conv with the

@@ -1,0 +1,94 @@
+"""Gradient parity of the Pallas fused 1x1-conv backward vs XLA's conv
+backward (round-2 verdict item 1's required test, following the
+tests/test_pallas_attention.py parity pattern).  Runs in interpret mode
+on the CPU mesh; the same code path compiles via Mosaic on TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from bluefog_tpu.parallel.pallas_conv import conv1x1, conv1x1_backward
+
+
+def _xla_conv1x1(x, w4, stride):
+    return lax.conv_general_dilated(
+        x, w4, window_strides=(stride, stride), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@pytest.mark.parametrize("stride", [1, 2])
+@pytest.mark.parametrize("shape", [(2, 8, 8, 16, 32), (1, 14, 14, 64, 24)])
+def test_conv1x1_grad_parity(stride, shape):
+    b, h, w_, ci, co = shape
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(b, h, w_, ci), jnp.float32)
+    w = jnp.asarray(rng.randn(ci, co) * 0.1, jnp.float32)
+    w4 = w.reshape(1, 1, ci, co)
+
+    def loss_pallas(x, w):
+        return jnp.sum(jnp.sin(conv1x1(x, w, stride)))
+
+    def loss_xla(x, w):
+        return jnp.sum(jnp.sin(_xla_conv1x1(x, w.reshape(1, 1, ci, co),
+                                            stride)))
+
+    y_p = conv1x1(x, w, stride)
+    y_x = _xla_conv1x1(x, w4, stride)
+    np.testing.assert_allclose(np.asarray(y_p), np.asarray(y_x),
+                               rtol=1e-5, atol=1e-5)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1))(x, w)
+    gx = jax.grad(loss_xla, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(gx[0]),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gx[1]),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv1x1_backward_matches_reference_math():
+    """Direct check of the fused kernel against einsum ground truth."""
+    rng = np.random.RandomState(1)
+    n, ci, co = 64, 16, 8
+    x = jnp.asarray(rng.randn(n, ci), jnp.float32)
+    dy = jnp.asarray(rng.randn(n, co), jnp.float32)
+    w = jnp.asarray(rng.randn(ci, co), jnp.float32)
+    dx, dw = conv1x1_backward(x, dy, w)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dy @ w.T),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(x.T @ dy),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv1x1_bf16_accumulates_f32():
+    """bf16 payloads must accumulate dw in f32 (not bf16 roundoff)."""
+    rng = np.random.RandomState(2)
+    n, ci, co = 4096, 8, 8
+    x = jnp.asarray(rng.randn(n, ci), jnp.bfloat16)
+    dy = jnp.asarray(rng.randn(n, co), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(ci, co), jnp.bfloat16)
+    _, dw = conv1x1_backward(x, dy, w)
+    assert dw.dtype == jnp.float32
+    ref = np.asarray(x, np.float32).T @ np.asarray(dy, np.float32)
+    # f32 accumulation keeps the relative error at bf16-input level
+    # (~1e-2), far tighter than bf16 accumulation over 4096 terms
+    err = np.abs(np.asarray(dw) - ref) / np.maximum(np.abs(ref), 1e-3)
+    assert err.max() < 2e-2, err.max()
+
+
+def test_conv1x1_odd_n_tile():
+    """N with few aligned divisors still tiles correctly (7x7 maps)."""
+    rng = np.random.RandomState(3)
+    b, h, w_, ci, co = 2, 7, 7, 32, 16  # n = 98
+    x = jnp.asarray(rng.randn(b, h, w_, ci), jnp.float32)
+    w = jnp.asarray(rng.randn(ci, co) * 0.1, jnp.float32)
+    g = jax.grad(lambda x, w: jnp.sum(conv1x1(x, w) ** 2),
+                 argnums=(0, 1))(x, w)
+    xf = x.reshape(-1, ci)
+    y = xf @ w
+    dy = 2 * y
+    np.testing.assert_allclose(np.asarray(g[0]).reshape(-1, ci),
+                               np.asarray(dy @ w.T), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g[1]), np.asarray(xf.T @ dy),
+                               rtol=1e-4, atol=1e-4)

@@ -153,6 +153,19 @@ def test_distributed_optimizer_minimize_communicates(bf_ctx):
     np.testing.assert_allclose(delta, expected, rtol=1e-6)
 
 
+def test_graph_mode_raises_clearly(bf_ctx):
+    """The adapter is eager-only (host numpy bridge): inside tf.function
+    it must fail with the documented error, not an AttributeError."""
+    n = bf_ctx.size()
+
+    @tf.function
+    def traced(x):
+        return tf_adapter.allreduce(x)
+
+    with pytest.raises(Exception, match="EAGER-ONLY"):
+        traced(tf.ones((n, 2)))
+
+
 def test_distributed_optimizer_rejects_unknown_mode(bf_ctx):
     with pytest.raises(ValueError, match="communication"):
         tf_adapter.DistributedOptimizer(
