@@ -166,7 +166,9 @@ def main():
               f"wgrad {row['wgrad_us']:.0f}us/{row['wgrad_mfu']:.0%}",
               file=sys.stderr)
 
-    rows.sort(key=lambda r: -r["bwd_total_us"])
+    # NaN rows (jitter-swamped measurements) sort LAST, not arbitrarily
+    rows.sort(key=lambda r: -r["bwd_total_us"]
+              if r["bwd_total_us"] == r["bwd_total_us"] else float("inf"))
     hdr = (f"{'conv':<16}{'xN':>3} {'shape':<20}{'GF':>6} "
            f"{'fwd us':>8}{'mfu':>5} {'dgrad':>8}{'mfu':>5} "
            f"{'wgrad':>8}{'mfu':>5} {'bwd tot us':>11}")

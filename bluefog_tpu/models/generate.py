@@ -15,6 +15,7 @@ shapes (``LlamaConfig.decode``), and both layer layouts (unrolled and
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Optional
 
@@ -27,27 +28,33 @@ from bluefog_tpu.models.llama import Llama, LlamaConfig
 __all__ = ["init_cache", "llama_generate"]
 
 
-def _decode_cfg(cfg: LlamaConfig, max_len: int) -> LlamaConfig:
-    """Single-device replicated decode: every mesh-axis knob is cleared
-    (the sharded axes are training-time layouts; generate takes
-    replicated params)."""
+def _decode_cfg(cfg: LlamaConfig, max_len: int,
+                keep_tp: bool = False) -> LlamaConfig:
+    """Decode layout: sequence/expert mesh knobs are cleared (they are
+    training-time layouts); tensor parallelism is KEPT when requested —
+    a tp-sharded K/V-cached decode serves checkpoints too big for one
+    chip (each shard holds its own heads' cache; outputs merge through
+    the same f/g psum pair as training)."""
     if cfg.n_experts:
         raise NotImplementedError(
             "llama_generate does not support MoE configs yet: expert "
             "capacity drops depend on how many tokens route together, so "
             "a cached decode (one token at a time) would not reproduce "
             "the full-forward logits token-for-token")
+    tp = {} if keep_tp else {"tp_axis": None, "tp_size": 1}
     return dataclasses.replace(
         cfg, decode=True, max_seq_len=max_len, attn_mode="full",
-        attn_impl="xla", sp_axis=None, tp_axis=None, tp_size=1,
-        ep_axis=None, ep_size=1, remat=False, remat_policy="none")
+        attn_impl="xla", sp_axis=None, ep_axis=None, ep_size=1,
+        remat=False, remat_policy="none", **tp)
 
 
-def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int):
+def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
+               keep_tp: bool = False):
     """Zero K/V caches for ``batch_size`` sequences of up to ``max_len``
     tokens — built from shapes only (``jax.eval_shape``), no forward
-    pass, no params needed."""
-    model = Llama(_decode_cfg(cfg, max_len))
+    pass, no params needed.  With ``keep_tp`` the shapes are PER-SHARD
+    (local kv heads) for the tp-sharded decode path."""
+    model = Llama(_decode_cfg(cfg, max_len, keep_tp=keep_tp))
     shapes = jax.eval_shape(
         lambda: model.init(jax.random.PRNGKey(0),
                            jnp.zeros((batch_size, 1), jnp.int32)))
@@ -58,7 +65,8 @@ def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int):
 def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
                    max_new_tokens: int, *, temperature: float = 0.0,
                    rng: Optional[jax.Array] = None,
-                   max_len: Optional[int] = None) -> jax.Array:
+                   max_len: Optional[int] = None,
+                   mesh=None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     Args:
@@ -88,21 +96,34 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
         raise ValueError("temperature sampling needs rng=")
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if cfg.tp_size > 1 and mesh is not None:
+        # tp-sharded decode: run the whole generate program under
+        # shard_map over the tp axis — params shard by the Megatron
+        # column/row layout, each shard keeps its own heads' K/V cache,
+        # and the psum-merged logits are replicated so every shard
+        # samples the same token (same rng).  Without mesh= the tp knobs
+        # are cleared and decode runs replicated (the original
+        # single-chip behavior).
+        dcfg = _decode_cfg(cfg, max_len, keep_tp=True)
+        fn = _tp_generate_program(dcfg, max_new_tokens,
+                                  temperature == 0.0, max_len, mesh)
+        return fn(variables["params"], prompt, jnp.float32(temperature),
+                  rng)
     return _generate_impl(
         variables, prompt, jnp.float32(temperature), rng,
         cfg=_decode_cfg(cfg, max_len), max_new_tokens=max_new_tokens,
         greedy=temperature == 0.0, max_len=max_len)
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "greedy",
-                                   "max_len"))
-def _generate_impl(variables, prompt, temperature, rng, *,
+def _generate_body(variables, prompt, temperature, rng, *,
                    cfg: LlamaConfig, max_new_tokens: int, greedy: bool,
                    max_len: int) -> jax.Array:
     b = prompt.shape[0]
     model = Llama(cfg)
     params = {"params": variables["params"]}
-    cache = init_cache(cfg, b, max_len)
+    # cfg here is already the decode layout; keep_tp preserves its tp
+    # knobs so the cache shapes are per-shard under the tp shard_map
+    cache = init_cache(cfg, b, max_len, keep_tp=cfg.tp_size > 1)
 
     def sample(logits_last, rng):
         if greedy:
@@ -130,3 +151,38 @@ def _generate_impl(variables, prompt, temperature, rng, *,
         [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1) \
         if max_new_tokens > 1 else tok[:, None]
     return jnp.concatenate([prompt, generated], axis=1)
+
+
+_generate_impl = partial(jax.jit, static_argnames=(
+    "cfg", "max_new_tokens", "greedy", "max_len"))(_generate_body)
+
+
+@functools.lru_cache(maxsize=8)
+def _tp_generate_program(dcfg: LlamaConfig, max_new_tokens: int,
+                         greedy: bool, max_len: int, mesh):
+    """Cached jitted shard_map program for tp-sharded decode — a serving
+    loop reuses ONE compilation per (config, token budget, mesh).  The
+    param partition specs derive from the config alone (via eval_shape),
+    so the cache key never needs the concrete params."""
+    from jax.sharding import PartitionSpec as P
+
+    from bluefog_tpu.models.llama import llama_param_specs
+
+    # structure-only init of the tp-CLEARED twin (identical param paths
+    # and ranks; tracing the tp model outside shard_map would hit
+    # unbound-axis psums)
+    plain = _decode_cfg(dcfg, dcfg.max_seq_len)
+    abstract = jax.eval_shape(
+        lambda: Llama(plain).init(jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 1), jnp.int32)))
+    pspecs = llama_param_specs(abstract["params"], rank_axis=None,
+                               tp_axis=dcfg.tp_axis, ep_axis=None)
+
+    def body(params, prompt, temperature, rng):
+        return _generate_body(
+            {"params": params}, prompt, temperature, rng, cfg=dcfg,
+            max_new_tokens=max_new_tokens, greedy=greedy, max_len=max_len)
+
+    sm = jax.shard_map(body, mesh=mesh, in_specs=(pspecs, P(), P(), P()),
+                       out_specs=P(), check_vma=False)
+    return jax.jit(sm)

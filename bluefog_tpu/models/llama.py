@@ -48,7 +48,13 @@ class LlamaConfig:
     attn_impl: str = "xla"  # xla | flash (Pallas kernel; composes with
     #                         attn_mode="ring" incl. training — the ring
     #                         VJP re-runs the Pallas bwd per ring step)
-    attn_block_size: int = 512  # for blockwise mode
+    attn_block_size: int = 512  # for blockwise/ring/ulysses modes
+    # Tile size for the full-sequence Pallas flash kernel.  Measured on
+    # v5e (round 3): 1024 beats 512 by +18% tokens/s at 200M and +13% at
+    # 1B end-to-end — at head_dim 64 the score matmul's contraction is
+    # only 64 deep, so bigger tiles are what amortize the MXU; VMEM per
+    # grid instance stays ~6 MB (f32 scores + tiles).  Clamped to t.
+    attn_flash_block_size: int = 1024
     sp_axis: Optional[str] = None  # mesh axis for ring mode
     # Tensor (Megatron-style) parallelism: heads + FFN hidden sharded over
     # ``tp_axis`` (``tp_size`` shards, static).  Column-parallel kernels
@@ -331,9 +337,9 @@ class Attention(nn.Module):
                 from bluefog_tpu.parallel.pallas_attention import (
                     flash_attention)
 
+                blk = min(cfg.attn_flash_block_size, t)
                 out = flash_attention(q, k, v, causal=True,
-                                      block_q=min(cfg.attn_block_size, t),
-                                      block_k=min(cfg.attn_block_size, t))
+                                      block_q=blk, block_k=blk)
             elif cfg.attn_mode == "blockwise":
                 out = blockwise_attention(q, k, v, cfg.attn_block_size,
                                           causal=True)
@@ -806,7 +812,7 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
     return loss_fn
 
 
-def llama_param_specs(params_or_shapes, rank_axis: str = "bf",
+def llama_param_specs(params_or_shapes, rank_axis: Optional[str] = "bf",
                       tp_axis: Optional[str] = "tp",
                       ep_axis: Optional[str] = "ep",
                       pp_axis: Optional[str] = None):
@@ -851,6 +857,10 @@ def llama_param_specs(params_or_shapes, rank_axis: str = "bf",
                 dims[-2] = tp_axis
         while dims and dims[-1] is None:  # canonical: no trailing Nones
             dims.pop()
+        if rank_axis is None:
+            # non-rank-major trees (e.g. replicated decode params whose
+            # only sharded axis is tp): specs without the rank dim
+            return P(*dims)
         return P(rank_axis, *dims)
 
     return jax.tree_util.tree_map_with_path(spec_for, params_or_shapes)

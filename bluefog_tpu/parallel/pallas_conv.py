@@ -59,10 +59,13 @@ def _tile_n(n: int, ci: int, co: int) -> int:
     resident blocks (w bf16 + dw f32 output + f32 accumulator scratch =
     10*ci*co bytes) plus DOUBLE-buffered streaming x/dy/dx blocks.
     Prefers sublane-aligned (multiple-of-8) divisors."""
-    # Mosaic pads the lane (last) dim to 128: budget with PADDED widths
+    # Mosaic pads the lane (last) dim to 128: budget with PADDED widths.
+    # Resident: w^T [co, ci] (bf16) + dw out [ci, co] (f32) + acc
+    # scratch [ci, co] (f32); streaming: x/dx [tn, ci] + dy [tn, co],
+    # double-buffered.
     ci_p = -(-ci // 128) * 128
     co_p = -(-co // 128) * 128
-    budget = 11 * 1024 * 1024 - (2 * ci * co_p + 8 * ci_p * co)
+    budget = 11 * 1024 * 1024 - (2 * co * ci_p + 8 * ci * co_p)
     row_bytes = 2 * 2 * (2 * ci_p + co_p)  # bf16 x + dx + dy, dbl-buffered
     target = max(min(budget // max(row_bytes, 1), n), 1)
     best = 1

@@ -70,6 +70,9 @@ parser.add_argument("--microbatches", type=int, default=0,
                     help="pipeline microbatches (default 2*pp; the "
                     "circular schedule requires at least pp)")
 parser.add_argument("--attn-impl", default="xla", choices=["xla", "flash"])
+parser.add_argument("--attn-block-size", type=int, default=0,
+                    help="flash/blockwise attention tile size "
+                    "(0 = config default)")
 parser.add_argument("--scan-layers", action="store_true",
                     help="nn.scan the decoder stack (O(1) compile in depth)")
 parser.add_argument("--bf16-logits", action="store_true",
@@ -115,6 +118,9 @@ def make_config():
                     attn_impl=args.attn_impl)
     elif args.attn_impl == "flash":
         base.update(attn_impl="flash")
+    if args.attn_block_size:
+        base.update(attn_block_size=args.attn_block_size,
+                    attn_flash_block_size=args.attn_block_size)
     if args.model == "tiny":
         return models.LlamaConfig.tiny(**base)
     if args.model == "200m":

@@ -119,6 +119,50 @@ def test_generate_clears_model_parallel_axes():
     np.testing.assert_array_equal(got, want)
 
 
+def test_tp_sharded_decode_matches_no_cache_rollout():
+    """Round-2 verdict item 8: K/V-cached generation under tp=2 (sharded
+    heads, per-shard caches, psum-merged logits) == the replicated
+    no-cache rollout, token for token.  This is the decode layout that
+    serves HF-imported checkpoints too big for one chip."""
+    from jax.sharding import Mesh
+
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32, tp_axis="tp",
+                                  tp_size=2)
+    plain = models.LlamaConfig.tiny(dtype=jnp.float32)
+    model = models.Llama(plain)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((B, 4), jnp.int32))
+    prompt = np.random.RandomState(0).randint(
+        0, 256, (B, T_PROMPT)).astype(np.int32)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    got = np.asarray(llama_generate(variables, cfg, jnp.asarray(prompt),
+                                    NEW, mesh=mesh))
+    want = _rollout_greedy(model, variables, prompt, NEW)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tp_sharded_decode_sampling_agrees_across_shards():
+    """Temperature sampling under tp: every shard draws from the SAME
+    replicated logits with the SAME rng — one consistent token stream."""
+    from jax.sharding import Mesh
+
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32, tp_axis="tp",
+                                  tp_size=2)
+    plain = models.LlamaConfig.tiny(dtype=jnp.float32)
+    model = models.Llama(plain)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((B, 4), jnp.int32))
+    prompt = np.random.RandomState(0).randint(
+        0, 256, (B, T_PROMPT)).astype(np.int32)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    rng = jax.random.PRNGKey(7)
+    a = np.asarray(llama_generate(variables, cfg, jnp.asarray(prompt), 5,
+                                  temperature=0.8, rng=rng, mesh=mesh))
+    b = np.asarray(llama_generate(variables, plain, jnp.asarray(prompt), 5,
+                                  temperature=0.8, rng=rng))
+    np.testing.assert_array_equal(a, b)
+
+
 def test_generate_from_hf_import():
     """HF-imported weights decode directly."""
     torch = pytest.importorskip("torch")

@@ -88,8 +88,11 @@ def test_llama_flash_impl_matches_xla():
     from bluefog_tpu import models
 
     cfg_x = models.LlamaConfig.tiny(dtype=jnp.float32)
+    # attn_flash_block_size=16 over t=32: exercises MULTI-BLOCK flash
+    # (online-softmax accumulation across k blocks), which the 1024
+    # default would clamp away at test sizes
     cfg_f = models.LlamaConfig.tiny(dtype=jnp.float32, attn_impl="flash",
-                                    attn_block_size=16)
+                                    attn_flash_block_size=16)
     toks = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0,
                               cfg_x.vocab_size)
     m_x, m_f = models.Llama(cfg_x), models.Llama(cfg_f)
