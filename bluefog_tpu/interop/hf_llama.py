@@ -33,17 +33,33 @@ def llama_config_from_hf(hf_config, **overrides) -> LlamaConfig:
     Compute/layout knobs (dtype, attn_mode, scan_layers, tp/ep/pp axes…)
     are orthogonal to the checkpoint and passed through ``overrides``.
 
-    Raises on config features this framework does not implement (rope
-    scaling, projection biases) — a silent pass-through would convert
-    mainstream checkpoints (e.g. Llama-3.1's ``rope_type='llama3'``)
+    ``rope_type='llama3'`` scaling (Llama-3.1+) maps onto the model's
+    ``rope_scaling_*`` fields; other scaling kinds and projection biases
+    raise — a silent pass-through would convert mainstream checkpoints
     into a model whose logits quietly diverge from ``transformers``."""
     rope_scaling = getattr(hf_config, "rope_scaling", None)
+    scaling_fields = {}
     if rope_scaling not in (None, {}):
-        raise NotImplementedError(
-            f"rope_scaling={rope_scaling!r} is not supported: this "
-            "framework applies unscaled rotary frequencies, so the "
-            "converted model's logits would NOT match transformers'. "
-            "Use a checkpoint without rope scaling (Llama-2/3.0 style).")
+        kind = rope_scaling.get("rope_type",
+                                rope_scaling.get("type", None))
+        if kind == "default":
+            pass  # explicit no-op scaling
+        elif kind == "llama3":
+            scaling_fields = dict(
+                rope_scaling_kind="llama3",
+                rope_scaling_factor=float(rope_scaling["factor"]),
+                rope_scaling_low_freq_factor=float(
+                    rope_scaling.get("low_freq_factor", 1.0)),
+                rope_scaling_high_freq_factor=float(
+                    rope_scaling.get("high_freq_factor", 4.0)),
+                rope_scaling_original_max_len=int(rope_scaling.get(
+                    "original_max_position_embeddings", 8192)))
+        else:
+            raise NotImplementedError(
+                f"rope_scaling={rope_scaling!r} is not supported: only "
+                "rope_type='llama3' (Llama-3.1 style) frequency scaling "
+                "is implemented; other kinds would make the converted "
+                "model's logits quietly diverge from transformers'.")
     for flag in ("attention_bias", "mlp_bias"):
         if getattr(hf_config, flag, False):
             raise NotImplementedError(
@@ -61,6 +77,7 @@ def llama_config_from_hf(hf_config, **overrides) -> LlamaConfig:
         max_seq_len=hf_config.max_position_embeddings,
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
         norm_eps=float(hf_config.rms_norm_eps),
+        **scaling_fields,
     )
     base.update(overrides)
     return LlamaConfig(**base)
