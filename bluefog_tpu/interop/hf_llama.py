@@ -45,15 +45,28 @@ def llama_config_from_hf(hf_config, **overrides) -> LlamaConfig:
         if kind == "default":
             pass  # explicit no-op scaling
         elif kind == "llama3":
+            # all four sub-fields are REQUIRED (transformers validates
+            # them too): silently assuming a default here would convert
+            # into a model whose logits quietly diverge — the exact
+            # failure this importer exists to prevent
+            required = ("factor", "low_freq_factor", "high_freq_factor",
+                        "original_max_position_embeddings")
+            missing = [k for k in required if k not in rope_scaling]
+            if missing:
+                raise ValueError(
+                    f"rope_scaling={rope_scaling!r} is missing required "
+                    f"llama3 field(s) {missing}; refusing to guess — "
+                    "the scaled frequencies would silently diverge "
+                    "from transformers'.")
             scaling_fields = dict(
                 rope_scaling_kind="llama3",
                 rope_scaling_factor=float(rope_scaling["factor"]),
                 rope_scaling_low_freq_factor=float(
-                    rope_scaling.get("low_freq_factor", 1.0)),
+                    rope_scaling["low_freq_factor"]),
                 rope_scaling_high_freq_factor=float(
-                    rope_scaling.get("high_freq_factor", 4.0)),
-                rope_scaling_original_max_len=int(rope_scaling.get(
-                    "original_max_position_embeddings", 8192)))
+                    rope_scaling["high_freq_factor"]),
+                rope_scaling_original_max_len=int(
+                    rope_scaling["original_max_position_embeddings"]))
         else:
             raise NotImplementedError(
                 f"rope_scaling={rope_scaling!r} is not supported: only "

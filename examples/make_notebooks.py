@@ -1,0 +1,244 @@
+"""Author the example notebooks programmatically (run from repo root:
+``python examples/make_notebooks.py``).  Two notebooks mirror the
+reference's interactive on-ramp (reference examples/
+interactive_bluefog_helloworld.ipynb and resource_allocation.ipynb):
+
+* ``interactive_helloworld.ipynb`` — the ibfrun native-engine cluster
+  driven from a notebook (the reference's ipyparallel %%px model,
+  without the broker).
+* ``decentralized_consensus.ipynb`` — in-process 8-virtual-device tour:
+  topologies, consensus rates, dynamic one-peer schedules, gossip
+  windows, and a decentralized training loop.
+
+Both are validated by tests/test_notebooks.py, which executes them
+end-to-end with nbclient.
+"""
+
+import nbformat as nbf
+
+
+def md(src):
+    return nbf.v4.new_markdown_cell(src)
+
+
+def code(src):
+    return nbf.v4.new_code_cell(src)
+
+
+def save(cells, path):
+    nb = nbf.v4.new_notebook(cells=cells, metadata={
+        "kernelspec": {"display_name": "Python 3", "language": "python",
+                       "name": "python3"},
+        "language_info": {"name": "python"},
+    })
+    nbf.write(nb, path)
+    print("wrote", path)
+
+
+hello = [
+    md("# BlueFog-TPU in a notebook\n\n"
+       "The reference framework's interactive on-ramp is `ibfrun` + "
+       "ipyparallel `%%px` (reference examples/"
+       "interactive_bluefog_helloworld.ipynb).  This build ships a "
+       "dependency-free equivalent: `ibfrun start -np N` launches "
+       "persistent **engine processes** (each one a `jax.distributed` "
+       "member), and `bluefog_tpu.run.engines.Client` broadcasts code "
+       "to every engine and gathers the results — the `%%px` execution "
+       "model without a broker.\n\n"
+       "This notebook starts a 2-engine cluster on simulated CPU "
+       "devices, runs a real cross-process collective, and tears the "
+       "cluster down.  On a TPU host, drop `force_cpu_devices` and the "
+       "engines bind the real chips."),
+    code("import os, socket\n"
+         "import numpy as np\n\n"
+         "# a scratch profile dir + free coordinator port for this demo\n"
+         "os.environ['BLUEFOG_TPU_STATE_DIR'] = os.path.abspath(\n"
+         "    './_nb_state')\n"
+         "s = socket.socket(); s.bind(('127.0.0.1', 0))\n"
+         "coordinator = f'127.0.0.1:{s.getsockname()[1]}'; s.close()"),
+    md("## Start the cluster\n\n"
+       "Outside a notebook you would run `ibfrun start -np 2` in a "
+       "terminal; the same entry point is callable as a function.  Each "
+       "engine simulates 2 CPU devices here, so the **world size is "
+       "4** (2 processes x 2 devices)."),
+    code("from bluefog_tpu.run import interactive_run as ir\n\n"
+         "rc = ir.start_native_cluster(2, 'nbdemo', coordinator,\n"
+         "                             force_cpu_devices=2)\n"
+         "assert rc == 0\n"
+         "state = ir.load_state('nbdemo')\n"
+         "state['engine_ports']"),
+    md("## Hello from every rank\n\n"
+       "`Client.execute` runs a code string on **every** engine "
+       "concurrently (engines keep a persistent namespace between "
+       "calls, like `%%px`); `Client.eval` gathers one value per "
+       "engine."),
+    code('from bluefog_tpu.run.engines import Client\n\n'
+         'c = Client("nbdemo")\n'
+         'c.execute("""\n'
+         'import numpy as np\n'
+         'import jax\n'
+         'import bluefog_tpu as bf\n'
+         'bf.init()\n'
+         'msg = (f"Hello, I am process {jax.process_index()} "\n'
+         '       f"of {jax.process_count()}; world size {bf.size()}")\n'
+         '""")\n'
+         'for line in c.eval("msg"):\n'
+         '    print(line)'),
+    md("## A real collective across the engines\n\n"
+       "The client sends to **all** engines before reading **any** "
+       "reply, so collective operations work: every engine enters "
+       "`neighbor_allreduce` together.  30 rounds of neighbor "
+       "averaging over the default exponential-2 graph drive every "
+       "rank to the global mean."),
+    code("c.execute(\n"
+         "    'x = bf.from_rank_values('\n"
+         "    '    lambda r: np.full((4,), float(r)))\\n'\n"
+         "    'for _ in range(30):\\n'\n"
+         "    '    x = bf.neighbor_allreduce(x)\\n'\n"
+         "    'mine = float(np.asarray('\n"
+         "    '    bf.to_rank_values(x)[jax.process_index()'\n"
+         "    '    * bf.local_size()]).mean())')\n"
+         "vals = c.eval('mine')\n"
+         "print('per-process consensus values:', vals)\n"
+         "expected = (c.eval('bf.size()')[0] - 1) / 2\n"
+         "assert all(abs(v - expected) < 1e-5 for v in vals)\n"
+         "print('all ranks agree on the mean', expected)"),
+    md("## Tear down\n\n"
+       "`shutdown()` stops the engines; `stop_cluster` cleans the "
+       "profile state (the CLI equivalent is `ibfrun stop`)."),
+    code("c.shutdown()\n"
+         "ir.stop_cluster('nbdemo')\n"
+         "print('cluster stopped')"),
+]
+
+consensus = [
+    md("# Decentralized averaging, topologies, and training\n\n"
+       "A self-contained tour of the BlueFog-TPU core on **8 simulated "
+       "devices in one process** (the same code runs unchanged on a "
+       "TPU pod — ranks are devices).  Mirrors the reference's "
+       "application notebook (reference examples/"
+       "resource_allocation.ipynb) on this framework's surface."),
+    code("import os\n"
+         "os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +\n"
+         "    ' --xla_force_host_platform_device_count=8')\n"
+         "import jax\n"
+         "jax.config.update('jax_platforms', 'cpu')\n"
+         "import numpy as np\n"
+         "import matplotlib\n"
+         "matplotlib.use('Agg')\n"
+         "import matplotlib.pyplot as plt\n\n"
+         "import bluefog_tpu as bf\n"
+         "bf.init()\n"
+         "n = bf.size()\n"
+         "print(f'{n} ranks on {jax.default_backend()}')"),
+    md("## 1. Average consensus over different topologies\n\n"
+       "Each rank starts with its own value; repeated "
+       "`neighbor_allreduce` (weighted neighbor averaging) drives all "
+       "ranks to the global mean.  The topology decides the "
+       "convergence RATE — the exponential-2 graph mixes in O(log n) "
+       "rounds, the ring in O(n^2)."),
+    code("from bluefog_tpu.topology import (ExponentialTwoGraph,\n"
+         "                                  RingGraph, StarGraph)\n\n"
+         "def consensus_curve(graph, rounds=25):\n"
+         "    bf.set_topology(graph)\n"
+         "    x = bf.from_rank_values(lambda r: np.full((1,), float(r)))\n"
+         "    errs = []\n"
+         "    for _ in range(rounds):\n"
+         "        x = bf.neighbor_allreduce(x)\n"
+         "        errs.append(float(np.max(np.abs(\n"
+         "            np.asarray(x) - (n - 1) / 2))))\n"
+         "    return errs\n\n"
+         "curves = {name: consensus_curve(g(n)) for name, g in [\n"
+         "    ('exponential-2', ExponentialTwoGraph),\n"
+         "    ('ring', RingGraph), ('star', StarGraph)]}\n"
+         "for name, errs in curves.items():\n"
+         "    plt.semilogy(errs, label=name)\n"
+         "plt.xlabel('round'); plt.ylabel('max |x - mean|')\n"
+         "plt.legend(); plt.title('consensus rate by topology')\n"
+         "plt.savefig('_consensus_rates.png', dpi=60)\n"
+         "print({k: f'{v[-1]:.2e}' for k, v in curves.items()})"),
+    md("The exponential-2 curve hits float32 noise in ~10 rounds; the "
+       "ring is visibly slower — topology choice IS the algorithm "
+       "here."),
+    md("## 2. Dynamic one-peer schedules\n\n"
+       "The reference's headline trick (reference README.rst:51-60): "
+       "instead of talking to log2(n) neighbors every round, talk to "
+       "**one** neighbor per round, rotating through the exponential-2 "
+       "shifts.  Per-round cost drops to a single parameter-size "
+       "transmit (one `collective-permute` in the compiled program — "
+       "machine-checked in tests/test_hlo_guarantees.py) while mixing "
+       "stays fast."),
+    code("from bluefog_tpu.topology.dynamic import (\n"
+         "    GetDynamicOnePeerSendRecvRanks)\n\n"
+         "bf.set_topology(ExponentialTwoGraph(n))\n"
+         "gens = [GetDynamicOnePeerSendRecvRanks(bf.load_topology(), r)\n"
+         "        for r in range(n)]\n"
+         "x = bf.from_rank_values(lambda r: np.full((1,), float(r)))\n"
+         "for _ in range(12):\n"
+         "    rounds = [next(g) for g in gens]\n"
+         "    x = bf.neighbor_allreduce(\n"
+         "        x, self_weight=0.5,\n"
+         "        src_weights=[{s: 0.5 for s in recv}\n"
+         "                     for _, recv in rounds],\n"
+         "        dst_weights=[{d: 1.0 for d in send}\n"
+         "                     for send, _ in rounds])\n"
+         "print('one-peer consensus err:',\n"
+         "      float(np.max(np.abs(np.asarray(x) - (n - 1) / 2))))"),
+    md("## 3. Asynchronous gossip with one-sided windows\n\n"
+       "`win_create` registers a named window; `win_put` pushes a "
+       "weighted copy into each out-neighbor's mailbox; `win_update` "
+       "combines what arrived.  No global barrier anywhere — this is "
+       "the reference's `win_*` family on TPU mailboxes."),
+    code("x = bf.from_rank_values(lambda r: np.full((2,), float(r)))\n"
+         "bf.win_create(x, 'nb_demo')\n"
+         "for _ in range(25):\n"
+         "    bf.win_put(x, 'nb_demo')\n"
+         "    x = bf.win_update('nb_demo')\n"
+         "bf.win_free('nb_demo')\n"
+         "print('gossip consensus err:',\n"
+         "      float(np.max(np.abs(np.asarray(x) - (n - 1) / 2))))"),
+    md("## 4. Decentralized training (the jitted fast path)\n\n"
+       "`optim.functional.build_train_step` compiles loss + gradient + "
+       "optimizer + neighbor communication into ONE XLA program.  Here "
+       "each rank owns a shard of a linear regression problem; "
+       "adapt-then-combine over the one-peer dynamic schedule recovers "
+       "the global solution."),
+    code("import jax.numpy as jnp\n"
+         "import optax\n"
+         "from bluefog_tpu.optim import functional as F\n"
+         "from bluefog_tpu.topology import one_peer_dynamic_schedule\n"
+         "from bluefog_tpu.context import get_context\n\n"
+         "rng = np.random.RandomState(0)\n"
+         "x_true = rng.randn(4)\n"
+         "As = np.stack([rng.randn(32, 4) for _ in range(n)])\n"
+         "bs = np.einsum('rsd,d->rs', As, x_true)\n\n"
+         "def loss_fn(params, batch):\n"
+         "    A, b = batch\n"
+         "    return jnp.mean((A @ params['w'] - b) ** 2)\n\n"
+         "opt = optax.sgd(0.05)\n"
+         "step = F.build_train_step(\n"
+         "    loss_fn, opt, get_context().mesh, comm_mode='atc',\n"
+         "    schedule=one_peer_dynamic_schedule(n))\n"
+         "params = F.rank_major({'w': jnp.zeros(4)}, get_context().mesh)\n"
+         "opt_state = F.rank_major(opt.init({'w': jnp.zeros(4)}),\n"
+         "                         get_context().mesh)\n"
+         "batch = (bf.rank_sharded(As), bf.rank_sharded(bs))\n"
+         "for i in range(150):\n"
+         "    params, opt_state, loss = step(params, opt_state, batch,\n"
+         "                                   jnp.int32(i))\n"
+         "w = np.asarray(bf.to_rank_values(params['w']))\n"
+         "print('per-rank error to x*:',\n"
+         "      np.abs(w - x_true).max(axis=1).round(4))\n"
+         "assert np.abs(w - x_true).max() < 0.05"),
+    md("Every rank converged to the global least-squares solution while "
+       "only ever talking to one neighbor per step.  From here: "
+       "`examples/resnet_benchmark.py` and `examples/llama_benchmark.py` "
+       "run the same `build_train_step` machinery at model scale, and "
+       "`docs/performance.md` records what it does on real v5e "
+       "hardware."),
+    code("bf.shutdown()\n"
+         "print('done')"),
+]
+
+save(hello, "examples/interactive_helloworld.ipynb")
+save(consensus, "examples/decentralized_consensus.ipynb")

@@ -109,6 +109,10 @@ def test_hf_unsupported_features_raise():
     hf_cfg.rope_scaling = {"rope_type": "yarn", "factor": 8.0}
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         llama_config_from_hf(hf_cfg)
+    # llama3 with missing sub-fields must refuse, not guess defaults
+    hf_cfg.rope_scaling = {"rope_type": "llama3", "factor": 8.0}
+    with pytest.raises(ValueError, match="missing required"):
+        llama_config_from_hf(hf_cfg)
     hf_cfg.rope_scaling = None
     hf_cfg.attention_bias = True
     with pytest.raises(NotImplementedError, match="attention_bias"):
