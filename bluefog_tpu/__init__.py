@@ -107,6 +107,11 @@ from bluefog_tpu.utility import (  # noqa: F401
 )
 
 from bluefog_tpu import topology  # noqa: F401
+from bluefog_tpu.topology import (  # noqa: F401
+    # reference exposes these on the main module (torch/__init__.py:109)
+    InferDestinationFromSourceRanks,
+    InferSourceFromDestinationRanks,
+)
 from bluefog_tpu import optim  # noqa: F401
 from bluefog_tpu import data  # noqa: F401
 from bluefog_tpu.data import (  # noqa: F401
