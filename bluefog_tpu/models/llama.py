@@ -57,12 +57,17 @@ class LlamaConfig:
     rope_scaling_low_freq_factor: float = 1.0
     rope_scaling_high_freq_factor: float = 4.0
     rope_scaling_original_max_len: int = 8192
-    # Tile size for the full-sequence Pallas flash kernel.  Measured on
-    # v5e (round 3): 1024 beats 512 by +18% tokens/s at 200M and +13% at
-    # 1B end-to-end — at head_dim 64 the score matmul's contraction is
-    # only 64 deep, so bigger tiles are what amortize the MXU; VMEM per
-    # grid instance stays ~6 MB (f32 scores + tiles).  Clamped to t.
+    # Tile sizes for the full-sequence Pallas flash kernel (q tile /
+    # k tile; both clamped to t).  Measured on v5e (round 3): 1024 q
+    # tiles beat 512 by +18% tokens/s at 200M and +13% at 1B end-to-end
+    # — at head_dim 64 the score matmul contracts only 64 deep, so big
+    # tiles are what amortize the MXU.  A 2048 k tile wins another ~15%
+    # on the FORWARD op but the backward kernel then exceeds the 16 MB
+    # scoped VMEM (19.07M) and fails to compile, so the trainable
+    # default stays symmetric; raise attn_flash_block_k for
+    # forward-only (inference/eval) runs.
     attn_flash_block_size: int = 1024
+    attn_flash_block_k: int = 1024
     sp_axis: Optional[str] = None  # mesh axis for ring mode
     # Tensor (Megatron-style) parallelism: heads + FFN hidden sharded over
     # ``tp_axis`` (``tp_size`` shards, static).  Column-parallel kernels
@@ -386,9 +391,10 @@ class Attention(nn.Module):
                 from bluefog_tpu.parallel.pallas_attention import (
                     flash_attention)
 
-                blk = min(cfg.attn_flash_block_size, t)
-                out = flash_attention(q, k, v, causal=True,
-                                      block_q=blk, block_k=blk)
+                out = flash_attention(
+                    q, k, v, causal=True,
+                    block_q=min(cfg.attn_flash_block_size, t),
+                    block_k=min(cfg.attn_flash_block_k, t))
             elif cfg.attn_mode == "blockwise":
                 out = blockwise_attention(q, k, v, cfg.attn_block_size,
                                           causal=True)

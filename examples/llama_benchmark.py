@@ -120,7 +120,8 @@ def make_config():
         base.update(attn_impl="flash")
     if args.attn_block_size:
         base.update(attn_block_size=args.attn_block_size,
-                    attn_flash_block_size=args.attn_block_size)
+                    attn_flash_block_size=args.attn_block_size,
+                    attn_flash_block_k=args.attn_block_size)
     if args.model == "tiny":
         return models.LlamaConfig.tiny(**base)
     if args.model == "200m":
