@@ -17,8 +17,8 @@ import jax
 import numpy as np
 
 __all__ = ["device_fetch", "fetch_overhead", "timed",
-           "chip_peak_flops", "compiled_step_flops", "mfu",
-           "hlo_collective_bytes"]
+           "chip_peak_flops", "chip_hbm_bandwidth", "compiled_step_flops",
+           "mfu", "hlo_collective_bytes"]
 
 # Dense bf16 peak FLOP/s per chip, from published TPU specs.  Keyed by
 # substrings of jax's ``device_kind``; override with BLUEFOG_CHIP_PEAK_TFLOPS
@@ -49,6 +49,37 @@ def chip_peak_flops(device=None) -> float:
     for key, tf in _PEAK_BF16_TFLOPS:
         if key in kind:
             return tf * 1e12
+    return 0.0
+
+
+# HBM bandwidth per chip (bytes/s), published specs; same keying and
+# override pattern as the FLOPs table (BLUEFOG_CHIP_HBM_GBPS).
+_HBM_GBPS = (
+    ("v6e", 1638.0),
+    ("v6", 1638.0),
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5 lite", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def chip_hbm_bandwidth(device=None) -> float:
+    """HBM bandwidth of one chip in bytes/s, or 0.0 when unknown (CPU
+    test meshes).  Override: BLUEFOG_CHIP_HBM_GBPS=<float>."""
+    import os
+
+    override = os.environ.get("BLUEFOG_CHIP_HBM_GBPS")
+    if override:
+        return float(override) * 1e9
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, gbps in _HBM_GBPS:
+        if key in kind:
+            return gbps * 1e9
     return 0.0
 
 

@@ -1,0 +1,111 @@
+"""K/V-cached decode throughput (tokens/sec) — the inference-side
+counterpart of llama_benchmark.py.
+
+Measures `llama_generate` end-to-end (prefill + scan decode, one
+compiled program) at a given batch/prompt/new-token budget, and
+reports per-sequence and aggregate decode tokens/sec plus the
+decode-step bandwidth utilization (decode is HBM-bound: every step
+reads all params + the K/V cache once).
+
+  PYTHONPATH=. python examples/decode_benchmark.py --model 200m \
+      --batch-size 8 --prompt-len 128 --new-tokens 256
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bluefog_tpu import models
+from bluefog_tpu.benchutil import (chip_hbm_bandwidth, device_fetch,
+                                   fetch_overhead)
+from bluefog_tpu.models import llama_generate
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--model", default="200m", choices=["tiny", "200m", "1b"])
+parser.add_argument("--batch-size", type=int, default=8)
+parser.add_argument("--prompt-len", type=int, default=128)
+parser.add_argument("--new-tokens", type=int, default=256)
+parser.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+parser.add_argument("--repeats", type=int, default=3)
+args = parser.parse_args()
+
+
+def make_config():
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    if args.model == "tiny":
+        return models.LlamaConfig.tiny(dtype=dtype)
+    if args.model == "200m":
+        return models.LlamaConfig(
+            vocab_size=32000, dim=1024, n_layers=12, n_heads=16,
+            n_kv_heads=4, hidden_dim=2816, max_seq_len=8192, dtype=dtype)
+    return models.LlamaConfig(
+        vocab_size=32000, dim=2048, n_layers=16, n_heads=32,
+        n_kv_heads=8, hidden_dim=5632, max_seq_len=8192, dtype=dtype)
+
+
+def main():
+    cfg = make_config()
+    model = models.Llama(cfg)
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (args.batch_size, args.prompt_len)),
+        jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((args.batch_size, 8), jnp.int32))
+    n_params = sum(p.size for p in jax.tree.leaves(variables["params"]))
+
+    def timed_generate(n_new):
+        # same cache size both runs, so the prefill programs match and
+        # the difference isolates the decode steps
+        out = llama_generate(variables, cfg, prompt, n_new,
+                             max_len=args.prompt_len + args.new_tokens)
+        device_fetch(out)  # compile + run once
+        rtt = fetch_overhead()
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            out = llama_generate(variables, cfg, prompt, n_new,
+                                 max_len=args.prompt_len + args.new_tokens)
+            device_fetch(out)
+            times.append(max(time.perf_counter() - t0 - rtt, 1e-9))
+        return float(np.median(times))
+
+    total_s = timed_generate(args.new_tokens)
+    prefill_s = timed_generate(1)  # prefill + one step
+    # decode-only: the remaining new_tokens - 1 scan steps
+    decode_s = max(total_s - prefill_s, 1e-9)
+    decode_steps = args.new_tokens - 1
+    toks_per_sec = args.batch_size * decode_steps / decode_s
+
+    # decode-step HBM floor: params once (in the COMPUTE dtype — XLA
+    # streams the casted copy) + the written K/V cache per step
+    bytes_per_el = 2 if args.dtype == "bf16" else 4
+    param_bytes = n_params * bytes_per_el
+    kv_bytes_mean = (2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+                     * args.batch_size
+                     * (args.prompt_len + args.new_tokens / 2)
+                     * bytes_per_el)
+    hbm = chip_hbm_bandwidth()
+    step_floor_s = (param_bytes + kv_bytes_mean) / hbm if hbm else 0.0
+    print(json.dumps({
+        "model": args.model, "params": int(n_params),
+        "batch": args.batch_size, "prompt_len": args.prompt_len,
+        "new_tokens": args.new_tokens, "dtype": args.dtype,
+        "decode_tokens_per_sec": round(toks_per_sec, 1),
+        "per_seq_tokens_per_sec": round(toks_per_sec / args.batch_size, 1),
+        "end_to_end_s": round(total_s, 3),
+        "prefill_plus_one_s": round(prefill_s, 3),
+        "hbm_bound_tokens_per_sec": round(
+            args.batch_size / step_floor_s, 1) if step_floor_s else None,
+        "hbm_utilization": round(
+            (decode_steps * step_floor_s) / decode_s, 3)
+        if step_floor_s else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -100,3 +100,15 @@ def test_resnet_benchmark_tiny():
         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
         "--num-iters", "1", timeout=360)
     assert "img/sec" in out
+
+
+def test_decode_benchmark_tiny():
+    out = run_example("decode_benchmark.py", "--model", "tiny",
+                      "--batch-size", "2", "--prompt-len", "8",
+                      "--new-tokens", "8", "--dtype", "f32",
+                      "--repeats", "1")
+    import json as _json
+
+    rec = _json.loads(out.strip().splitlines()[-1])
+    assert rec["decode_tokens_per_sec"] > 0
+    assert rec["new_tokens"] == 8
