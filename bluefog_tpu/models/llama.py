@@ -59,15 +59,15 @@ class LlamaConfig:
     rope_scaling_original_max_len: int = 8192
     # Tile sizes for the full-sequence Pallas flash kernel (q tile /
     # k tile; both clamped to t).  Measured on v5e (round 3): 1024 q
-    # tiles beat 512 by +18% tokens/s at 200M and +13% at 1B end-to-end
-    # — at head_dim 64 the score matmul contracts only 64 deep, so big
-    # tiles are what amortize the MXU.  A 2048 k tile wins another ~15%
-    # on the FORWARD op but the backward kernel then exceeds the 16 MB
-    # scoped VMEM (19.07M) and fails to compile, so the trainable
-    # default stays symmetric; raise attn_flash_block_k for
-    # forward-only (inference/eval) runs.
+    # tiles beat 512 by +18% tokens/s at 200M and +13% at 1B end-to-end,
+    # and the 2048 k tile wins another ~15% on the attention forward —
+    # at head_dim 64 the score matmul contracts only 64 deep, so big
+    # tiles are what amortize the MXU.  The backward pass auto-shrinks
+    # its q tile to keep its two score-sized f32 intermediates inside
+    # the 16 MB scoped VMEM (see _flash_bwd_impl), so the big k tile is
+    # safe to train with.
     attn_flash_block_size: int = 1024
-    attn_flash_block_k: int = 1024
+    attn_flash_block_k: int = 2048
     sp_axis: Optional[str] = None  # mesh axis for ring mode
     # Tensor (Megatron-style) parallelism: heads + FFN hidden sharded over
     # ``tp_axis`` (``tp_size`` shards, static).  Column-parallel kernels

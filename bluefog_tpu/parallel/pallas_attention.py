@@ -265,6 +265,14 @@ def _flash_bwd_impl(q, k, v, out, lse, do, q_offset, kv_offset, *, causal,
     group = h // h_kv
     block_q = _fit_block(t_q, block_q)
     block_k = _fit_block(t_k, block_k)
+    # Both bwd kernels materialize TWO f32 [block_q, block_k] score-sized
+    # intermediates (p and dp) — cap their product at 1M elements (8 MB)
+    # so large k tiles (which the forward can afford with its single
+    # score buffer) don't blow the 16 MB scoped-VMEM budget here; the
+    # q tile shrinks instead, which bwd tolerates (its accumulators are
+    # keyed on k blocks).
+    while block_q * block_k > (1 << 20) and block_q > 8:
+        block_q = _fit_block(t_q, block_q // 2)
 
     qt = jnp.moveaxis(q, 2, 1).reshape(b * h, t_q, d)
     kt = jnp.moveaxis(k, 2, 1).reshape(b * h_kv, t_k, d)
