@@ -9,10 +9,16 @@ migrates onto.  Like the torch bridge, it accepts **rank-major tensors**
 (``[n_ranks, ...]``, host-resident) and converts through numpy; the
 jitted JAX path remains the performance surface.
 
-EAGER-ONLY: every op bridges through host numpy, so none of this
-surface works inside ``tf.function`` / Keras ``model.fit`` graph
-tracing (use ``run_eagerly=True`` there, or the JAX-native API for
-compiled paths) — the guard in ``_to_jax`` raises with this message.
+GRAPH MODE: inside ``tf.function`` / compiled Keras ``model.fit`` the
+ops lower to ``tf.py_function`` nodes (reference parity: the
+reference's TF custom ops run inside TF graphs,
+tensorflow/mpi_ops.cc:1-235) — the graph calls back into the eager
+numpy bridge at execution time.  PERFORMANCE CAVEAT, stated as loudly
+as docs/interop.md does for torch: every op is still a host
+round-trip (device->host->JAX->host->device), in eager AND graph
+mode.  This surface is a correctness/migration bridge; the jitted
+JAX path is the performance surface.  Direct calls on symbolic
+tensors outside the provided ops raise in ``_to_jax``.
 
 Gradient flow matches the reference's registered gradients:
 ``allreduce``'s gradient is an allreduce (reference mpi_ops.py:95-106),
@@ -53,15 +59,15 @@ def _to_jax(tensor):
 
     _require_tf()
     if not tf.executing_eagerly():
-        # symbolic tensors have no .numpy(); the host numpy bridge is
-        # inherently eager (same restriction class as BLUEFOG_OPS_ON_CPU
-        # staging in the reference) — fail with the reason, not an
-        # AttributeError deep inside
+        # symbolic tensors have no .numpy(); the module's public ops
+        # route graph-mode calls through tf.py_function (see _bridge),
+        # which re-enters eager execution — only a direct _to_jax on a
+        # symbolic tensor can land here
         raise RuntimeError(
-            "bluefog_tpu.interop.tf_adapter is EAGER-ONLY: its ops bridge "
-            "through host numpy and cannot run inside tf.function / "
-            "Keras model.fit graphs. Call them eagerly (run_eagerly=True "
-            "for Keras) or use the JAX-native API for compiled paths.")
+            "bluefog_tpu.interop.tf_adapter: got a symbolic tensor "
+            "outside tf.py_function. Use the adapter's public ops "
+            "(they wrap graph-mode calls in tf.py_function) or call "
+            "eagerly.")
     if not tf.is_tensor(tensor):
         tensor = tf.convert_to_tensor(tensor)
     if (tensor.dtype in (tf.float64, tf.int64)
@@ -80,6 +86,19 @@ def _to_tf(array, like=None):
     return out
 
 
+def _bridge(eager_fn, x, out_shape=None):
+    """Run ``eager_fn`` (the numpy/JAX bridge) on ``x`` now if eager, or
+    as a ``tf.py_function`` graph node if tracing — the reference's TF
+    custom ops run inside graphs (reference tensorflow/mpi_ops.py:77-230);
+    py_function is the TPU build's equivalent graph hook, with the same
+    host round-trip the eager path already takes."""
+    if tf.executing_eagerly():
+        return eager_fn(x)
+    y = tf.py_function(eager_fn, [x], Tout=x.dtype)
+    y.set_shape(x.shape if out_shape is None else out_shape)
+    return y
+
+
 def allreduce(tensor, average: bool = True, name: Optional[str] = None):
     """Rank-major tf tensor -> global (average) reduction.  Differentiable:
     the pulled-back cotangent is itself allreduced (reference
@@ -88,12 +107,14 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
 
     @tf.custom_gradient
     def _op(x):
-        y = _to_tf(bf.allreduce(_to_jax(x), average=average, name=name),
-                   like=x)
+        y = _bridge(
+            lambda t: _to_tf(bf.allreduce(_to_jax(t), average=average,
+                                          name=name), like=t), x)
 
         def grad(dy):
-            return _to_tf(bf.allreduce(_to_jax(dy), average=average),
-                          like=dy)
+            return _bridge(
+                lambda t: _to_tf(bf.allreduce(_to_jax(t), average=average),
+                                 like=t), dy)
 
         return y, grad
 
@@ -108,13 +129,18 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
 
     @tf.custom_gradient
     def _op(x):
-        y = _to_tf(bf.broadcast(_to_jax(x), root_rank, name=name), like=x)
+        y = _bridge(
+            lambda t: _to_tf(bf.broadcast(_to_jax(t), root_rank,
+                                          name=name), like=t), x)
 
         def grad(dy):
-            summed = bf.allreduce(_to_jax(dy), average=False)
-            g = np.zeros_like(np.asarray(summed))
-            g[root_rank] = np.asarray(summed)[root_rank]
-            return _to_tf(g, like=dy)
+            def _g(t):
+                summed = bf.allreduce(_to_jax(t), average=False)
+                g = np.zeros_like(np.asarray(summed))
+                g[root_rank] = np.asarray(summed)[root_rank]
+                return _to_tf(g, like=t)
+
+            return _bridge(_g, dy)
 
         return y, grad
 
@@ -129,7 +155,21 @@ def allgather(tensor, name: Optional[str] = None):
 
     @tf.custom_gradient
     def _op(x):
-        y = _to_tf(bf.allgather(_to_jax(x), name=name), like=x)
+        # output is [n, n*rows, ...] for [n, rows, ...] input; keep every
+        # statically-unknown dim unknown rather than stamping the input
+        # shape (rank<2 is rejected by the eager path at runtime)
+        if x.shape.rank is not None and x.shape.rank > 1:
+            n_static, rows_static = x.shape[0], x.shape[1]
+            mid = (n_static * rows_static
+                   if n_static is not None and rows_static is not None
+                   else None)
+            gathered = tf.TensorShape(
+                [n_static, mid]).concatenate(x.shape[2:])
+        else:
+            gathered = tf.TensorShape(None)
+        y = _bridge(
+            lambda t: _to_tf(bf.allgather(_to_jax(t), name=name), like=t),
+            x, out_shape=gathered)
 
         def grad(dy):
             n = bf.size()
@@ -155,13 +195,15 @@ def neighbor_allreduce(tensor, *, self_weight=None, src_weights=None,
     had — its TF users were limited to allreduce; exposed here so the TF
     surface reaches capability parity with the torch one)."""
     _require_tf()
-    return _to_tf(
-        bf.neighbor_allreduce(_to_jax(tensor), self_weight=self_weight,
-                              src_weights=src_weights,
-                              dst_weights=dst_weights,
-                              enable_topo_check=enable_topo_check,
-                              name=name),
-        like=tensor)
+    return _bridge(
+        lambda t: _to_tf(
+            bf.neighbor_allreduce(_to_jax(t), self_weight=self_weight,
+                                  src_weights=src_weights,
+                                  dst_weights=dst_weights,
+                                  enable_topo_check=enable_topo_check,
+                                  name=name),
+            like=t),
+        tf.convert_to_tensor(tensor))
 
 
 def broadcast_variables(variables, root_rank: int = 0):
@@ -202,6 +244,25 @@ class DistributedOptimizer:
             for _, v in grads_and_vars:
                 v.assign(neighbor_allreduce(v))
         return result
+
+    def apply(self, grads, trainable_variables=None, **kwargs):
+        """Keras-3 entry point (``Model.train_step`` calls
+        ``optimizer.apply``): route through the communicating
+        ``apply_gradients`` so a compiled ``model.fit`` still averages
+        gradients / combines neighbors."""
+        if trainable_variables is None:
+            # Keras-3 one-arg form: the built optimizer knows its
+            # variables; bare grads must NOT reach apply_gradients
+            # (it unpacks (grad, var) pairs)
+            trainable_variables = getattr(
+                self.optimizer, "_trainable_variables", None)
+            if not trainable_variables:
+                raise ValueError(
+                    "apply(grads) without trainable_variables requires "
+                    "the wrapped optimizer to be built; pass "
+                    "trainable_variables explicitly")
+        return self.apply_gradients(
+            list(zip(grads, trainable_variables)), **kwargs)
 
     def minimize(self, loss, var_list, tape=None):
         """Route through the communicating ``apply_gradients`` — the

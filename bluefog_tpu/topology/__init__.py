@@ -41,3 +41,12 @@ from bluefog_tpu.topology.infer import (  # noqa: F401
     InferSourceFromDestinationRanks,
     InferDestinationFromSourceRanks,
 )
+from bluefog_tpu.topology.torus import (  # noqa: F401
+    TorusSpec,
+    torus_one_peer_schedule,
+    torus_shift_round,
+    round_congestion,
+    schedule_congestion,
+    consensus_contraction,
+    rounds_to_consensus,
+)

@@ -1,0 +1,176 @@
+"""Torus-aware schedules + machine-checked ICI congestion accounting
+(topology/torus.py) — the round-4 evidence behind the scaling projection's
+pessimistic routing model.
+
+The reference has no counterpart (its NCCL/MPI backends never see link
+topology); these tests pin the congestion counter to hand-derived cases
+and the torus schedules to their construction guarantees.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bluefog_tpu.optim import functional as F
+from bluefog_tpu.topology import (
+    TorusSpec,
+    consensus_contraction,
+    one_peer_dynamic_schedule,
+    round_congestion,
+    rounds_to_consensus,
+    schedule_congestion,
+    torus_one_peer_schedule,
+    torus_shift_round,
+)
+from bluefog_tpu.topology.torus import link_loads, mixing_matrix
+
+N = 8
+
+
+def test_coord_rank_roundtrip_and_neighbors():
+    spec = TorusSpec((4, 8))
+    for r in range(spec.size):
+        assert spec.rank(spec.coord(r)) == r
+    # wraparound neighbors on both axes
+    assert spec.is_neighbor(spec.rank((0, 0)), spec.rank((3, 0)))
+    assert spec.is_neighbor(spec.rank((0, 0)), spec.rank((0, 7)))
+    assert not spec.is_neighbor(spec.rank((0, 0)), spec.rank((1, 1)))
+    assert not spec.is_neighbor(spec.rank((0, 0)), spec.rank((0, 2)))
+
+
+def test_unit_shift_congestion_is_one():
+    """A +1 rotation along a ring loads every directed link exactly once."""
+    spec = TorusSpec((8,))
+    send = {r: (r + 1) % 8 for r in range(8)}
+    loads = link_loads(send, spec)
+    assert set(loads.values()) == {1.0}
+    assert len(loads) == 8  # the 8 forward links, nothing else
+
+
+def test_half_ring_shift_splits_both_directions():
+    """An L/2 shift ties both directions; splitting halves the congestion
+    (4 nodes x 2 hops x 0.5 = 1.0 per directed link on a 4-ring)."""
+    spec = TorusSpec((4,))
+    send = {r: (r + 2) % 4 for r in range(4)}
+    assert round_congestion(send, spec) == pytest.approx(1.0)
+
+
+def test_tie_split_walks_opposite_semicircle():
+    """The -1 half of an L/2 tie must load the backward semicircle FROM
+    THE SOURCE, not retrace the forward path's links in reverse (round-4
+    review regression): send {0->4, 6->5, 7->6} on an 8-ring piles the
+    0->4 backward half (links leaving 0,7,6,5 in -1) on top of the two
+    -1 unit hops, so links (7,-1) and (6,-1) carry 1.5 payloads."""
+    spec = TorusSpec((8,))
+    loads = link_loads({0: 4, 6: 5, 7: 6}, spec)
+    assert loads[((7,), 0, -1)] == pytest.approx(1.5)
+    assert loads[((6,), 0, -1)] == pytest.approx(1.5)
+    assert round_congestion({0: 4, 6: 5, 7: 6}, spec) == pytest.approx(1.5)
+
+
+def test_long_shift_congestion_matches_hand_count():
+    """Shift +2 on an 8-ring: every payload takes 2 forward hops; each of
+    the 8 forward links carries exactly 2 payloads."""
+    spec = TorusSpec((8,))
+    send = {r: (r + 2) % 8 for r in range(8)}
+    assert round_congestion(send, spec) == pytest.approx(2.0)
+    # and the backward direction is minimal for shift +6
+    send = {r: (r + 6) % 8 for r in range(8)}
+    assert round_congestion(send, spec) == pytest.approx(2.0)
+
+
+def test_exp2_schedule_congestion_beats_1d_bound():
+    """The one-peer exp2 schedule machine-routed on the (8, 16) torus is
+    far below the 1-D closed-form min(2^k, n-2^k) hop guess — the round-3
+    projection's pessimistic model was a loose bound, not the truth."""
+    spec = TorusSpec((8, 16))
+    sched = one_peer_dynamic_schedule(128)
+    prof = schedule_congestion(sched, spec)
+    one_d = [min(2 ** k, 128 - 2 ** k) for k in range(7)]
+    assert prof["mean"] < np.mean(one_d) / 5  # 2.29 vs 18.14
+    for got, bound in zip(prof["per_round"], one_d):
+        assert 1.0 <= got <= bound
+
+
+def test_single_hop_schedule_properties():
+    """Every round: a permutation of in/out degree 1, every edge a physical
+    ICI neighbor, congestion exactly 1, weights 1/2-1/2."""
+    for axes in ((2, 4), (8, 16)):
+        spec = TorusSpec(axes)
+        sched = torus_one_peer_schedule(axes, "single_hop")
+        assert len(sched) == sum(2 if L > 2 else 1 for L in axes)
+        for rnd in sched:
+            srcs = [s for s, _ in rnd.edges]
+            dsts = [d for _, d in rnd.edges]
+            assert sorted(srcs) == list(range(spec.size))
+            assert sorted(dsts) == list(range(spec.size))
+            assert all(spec.is_neighbor(s, d) for s, d in rnd.edges)
+            # length-2 axes have two links joining each pair (wrap +
+            # direct), so the tie-split halves the load there
+            cong = round_congestion(rnd, spec)
+            if min(axes) > 2:
+                assert cong == pytest.approx(1.0)
+            else:
+                assert cong <= 1.0
+            assert set(rnd.edge_weight_values) == {0.5}
+            assert set(rnd.self_weight_values) == {0.5}
+
+
+def test_exp2_mode_reaches_exact_average():
+    """Per-axis exp2 with power-of-two axes: one period is exact recursive
+    halving (sigma == 0), both on (4, 4) and the pod shape (8, 16)."""
+    for axes in ((4, 4), (8, 16)):
+        sched = torus_one_peer_schedule(axes, "exp2")
+        assert consensus_contraction(sched) < 1e-12
+        assert rounds_to_consensus(sched) == len(sched)
+        # simulate: arbitrary vector -> exact mean after one period
+        n = int(np.prod(axes))
+        x = np.arange(n, dtype=np.float64) ** 2
+        for rnd in sched:
+            x = mixing_matrix(rnd) @ x
+        np.testing.assert_allclose(x, np.mean(np.arange(n) ** 2.0),
+                                   rtol=1e-12)
+
+
+def test_single_hop_mixing_contracts():
+    """The single-hop schedule mixes (sigma < 1) but slower than exp2 —
+    the tradeoff the projection's mixing table quantifies."""
+    sched = torus_one_peer_schedule((4, 4), "single_hop")
+    sigma = consensus_contraction(sched)
+    assert 0.0 < sigma < 1.0
+    r = rounds_to_consensus(sched, eps=1e-3)
+    assert np.isfinite(r) and r > len(sched)
+
+
+def test_shift_round_weight_structure():
+    rnd = torus_shift_round(TorusSpec((2, 4)), axis=1, shift=1,
+                            self_weight=0.75)
+    assert set(rnd.edge_weight_values) == {0.25}
+    W = mixing_matrix(rnd)
+    np.testing.assert_allclose(W.sum(axis=1), 1.0)  # row-stochastic
+
+
+def test_train_step_with_torus_schedule():
+    """Integration: the single-hop torus schedule drives the jitted train
+    step on the 8-device (2, 4) virtual torus and reaches consensus under
+    pure averaging, exactly like the exp2 dynamic schedule."""
+    mesh = Mesh(np.array(jax.devices()[:N]), ("bf",))
+    schedule = torus_one_peer_schedule((2, 4), "single_hop")
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch @ params["x"]) ** 2)
+
+    step_fn = F.build_train_step(
+        loss_fn, optax.sgd(0.0), mesh, comm_mode="cta", schedule=schedule)
+    params = {"x": jax.device_put(
+        np.arange(N * 4, dtype=np.float64).reshape(N, 4),
+        NamedSharding(mesh, P("bf")))}
+    opt_state = F.rank_major(optax.sgd(0.0).init({"x": jnp.zeros(4)}), mesh)
+    batch = jax.device_put(np.ones((N, 2, 4)), NamedSharding(mesh, P("bf")))
+    for i in range(20 * len(schedule)):
+        params, opt_state, _ = step_fn(params, opt_state, batch,
+                                       jnp.int32(i))
+    assert float(F.consensus_distance(params)) < 1e-6
