@@ -24,6 +24,7 @@ from bluefog_tpu.models.llama import (
     llama_pp_loss_fn,
 )
 from bluefog_tpu.models.generate import init_cache, llama_generate
+from bluefog_tpu.models.quant import quantize_llama_params
 from bluefog_tpu.models.vit import ViT, ViTConfig, ViT_B16, ViT_S16
 
 __all__ = [
@@ -46,4 +47,5 @@ __all__ = [
     "llama_circular_layout",
     "llama_generate",
     "init_cache",
+    "quantize_llama_params",
 ]

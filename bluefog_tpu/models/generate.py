@@ -28,8 +28,9 @@ from bluefog_tpu.models.llama import Llama, LlamaConfig
 __all__ = ["init_cache", "llama_generate"]
 
 
-def _decode_cfg(cfg: LlamaConfig, max_len: int,
-                keep_tp: bool = False) -> LlamaConfig:
+def _decode_cfg(cfg: LlamaConfig, max_len: int, keep_tp: bool = False,
+                kv_quant: str = "none",
+                weight_quant: str = "none") -> LlamaConfig:
     """Decode layout: sequence/expert mesh knobs are cleared (they are
     training-time layouts); tensor parallelism is KEPT when requested —
     a tp-sharded K/V-cached decode serves checkpoints too big for one
@@ -45,16 +46,19 @@ def _decode_cfg(cfg: LlamaConfig, max_len: int,
     return dataclasses.replace(
         cfg, decode=True, max_seq_len=max_len, attn_mode="full",
         attn_impl="xla", sp_axis=None, ep_axis=None, ep_size=1,
-        remat=False, remat_policy="none", **tp)
+        remat=False, remat_policy="none", kv_quant=kv_quant,
+        param_quant=weight_quant, **tp)
 
 
 def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
-               keep_tp: bool = False):
+               keep_tp: bool = False, kv_quant: str = "none"):
     """Zero K/V caches for ``batch_size`` sequences of up to ``max_len``
     tokens — built from shapes only (``jax.eval_shape``), no forward
     pass, no params needed.  With ``keep_tp`` the shapes are PER-SHARD
-    (local kv heads) for the tp-sharded decode path."""
-    model = Llama(_decode_cfg(cfg, max_len, keep_tp=keep_tp))
+    (local kv heads) for the tp-sharded decode path; ``kv_quant='int8'``
+    yields the int8 + per-vector-scale cache layout."""
+    model = Llama(_decode_cfg(cfg, max_len, keep_tp=keep_tp,
+                              kv_quant=kv_quant))
     shapes = jax.eval_shape(
         lambda: model.init(jax.random.PRNGKey(0),
                            jnp.zeros((batch_size, 1), jnp.int32)))
@@ -66,7 +70,8 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
                    max_new_tokens: int, *, temperature: float = 0.0,
                    rng: Optional[jax.Array] = None,
                    max_len: Optional[int] = None,
-                   mesh=None) -> jax.Array:
+                   mesh=None, kv_quant: str = "none",
+                   weight_quant: str = "none") -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     Args:
@@ -81,6 +86,20 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
         temperature (needs ``rng``).  Traced — changing the temperature
         does NOT recompile (only switching greedy <-> sampling does).
       max_len: cache length; defaults to ``T_prompt + max_new_tokens``.
+      kv_quant: "int8" stores the K/V cache as int8 with per-vector f32
+        scales — half the cache HBM traffic (decode is bandwidth-bound).
+      weight_quant: "int8" (weight-only) or "w8a8" (also quantizes
+        activations per token and runs native s8xs8 MXU dots) run every
+        projection + the logits head from int8 kernels with
+        per-output-channel scales.  The faster mode is SCALE-DEPENDENT
+        (measured, docs/performance.md round 4): "w8a8" wins at ~200M
+        (the weight-only convert path is VPU-bound there), "int8" wins
+        at ~1B+ (larger contractions amortize the convert and w8a8's
+        activation-quant overhead flips the ordering) — benchmark both
+        with examples/decode_benchmark.py.  ``variables`` must already
+        be the quantized tree
+        (:func:`bluefog_tpu.models.quant.quantize_llama_params` — do it
+        once offline, not per call).
 
     Returns ``[B, T_prompt + max_new_tokens]`` int32: prompt ‖ generation.
     """
@@ -96,6 +115,14 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
         raise ValueError("temperature sampling needs rng=")
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    from bluefog_tpu.models.quant import is_quantized_params
+
+    if (weight_quant != "none") != is_quantized_params(variables):
+        raise ValueError(
+            "weight_quant='int8'/'w8a8' requires params converted by "
+            "quantize_llama_params (and full-precision params require "
+            "weight_quant='none'); got a mismatched tree")
+    quant = dict(kv_quant=kv_quant, weight_quant=weight_quant)
     if cfg.tp_size > 1 and mesh is not None:
         # tp-sharded decode: run the whole generate program under
         # shard_map over the tp axis — params shard by the Megatron
@@ -104,14 +131,15 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
         # samples the same token (same rng).  Without mesh= the tp knobs
         # are cleared and decode runs replicated (the original
         # single-chip behavior).
-        dcfg = _decode_cfg(cfg, max_len, keep_tp=True)
+        dcfg = _decode_cfg(cfg, max_len, keep_tp=True, **quant)
         fn = _tp_generate_program(dcfg, max_new_tokens,
                                   temperature == 0.0, max_len, mesh)
         return fn(variables["params"], prompt, jnp.float32(temperature),
                   rng)
     return _generate_impl(
         variables, prompt, jnp.float32(temperature), rng,
-        cfg=_decode_cfg(cfg, max_len), max_new_tokens=max_new_tokens,
+        cfg=_decode_cfg(cfg, max_len, **quant),
+        max_new_tokens=max_new_tokens,
         greedy=temperature == 0.0, max_len=max_len)
 
 
@@ -123,7 +151,8 @@ def _generate_body(variables, prompt, temperature, rng, *,
     params = {"params": variables["params"]}
     # cfg here is already the decode layout; keep_tp preserves its tp
     # knobs so the cache shapes are per-shard under the tp shard_map
-    cache = init_cache(cfg, b, max_len, keep_tp=cfg.tp_size > 1)
+    cache = init_cache(cfg, b, max_len, keep_tp=cfg.tp_size > 1,
+                       kv_quant=cfg.kv_quant)
 
     def sample(logits_last, rng):
         if greedy:
@@ -169,9 +198,11 @@ def _tp_generate_program(dcfg: LlamaConfig, max_new_tokens: int,
     from bluefog_tpu.models.llama import llama_param_specs
 
     # structure-only init of the tp-CLEARED twin (identical param paths
-    # and ranks; tracing the tp model outside shard_map would hit
+    # and ranks — including QuantDense's scale leaves, so weight_quant
+    # carries over; tracing the tp model outside shard_map would hit
     # unbound-axis psums)
-    plain = _decode_cfg(dcfg, dcfg.max_seq_len)
+    plain = _decode_cfg(dcfg, dcfg.max_seq_len,
+                        weight_quant=dcfg.param_quant)
     abstract = jax.eval_shape(
         lambda: Llama(plain).init(jax.random.PRNGKey(0),
                                   jnp.zeros((1, 1), jnp.int32)))

@@ -141,6 +141,21 @@ class LlamaConfig:
     # default), False runs it in the compute dtype with the logits cast
     # to f32 afterwards — ~2x faster head at bf16-rounded logits.
     logits_dot_in_fp32: bool = True
+    # Inference-time quantization (decode is HBM-bound: every step
+    # streams all params + the K/V cache once, so bytes ARE time).
+    # kv_quant="int8": the decode K/V caches store int8 with one f32
+    # scale per (batch, position, kv_head) vector; both scales commute
+    # out of the attention contractions (over head_dim for scores, over
+    # positions via the probabilities for values), so dequantization
+    # fuses into the matmul operand reads and HBM traffic halves.
+    # param_quant="int8": every projection kernel (wq/wk/wv/wo/w1/w2/w3
+    # and the logits head) stores int8 with a per-output-channel f32
+    # scale applied to the matmul OUTPUT ((x @ W_q) * s == x @ (W_q * s)
+    # exactly, since s is constant along the contraction) — see
+    # QuantDense.  Both are decode-only knobs (set via llama_generate);
+    # training stays full precision.
+    kv_quant: str = "none"  # none | int8
+    param_quant: str = "none"  # none | int8
 
     def __post_init__(self):
         if self.decode and self.attn_mode != "full":
@@ -155,6 +170,23 @@ class LlamaConfig:
                 "together, so a cached decode cannot reproduce the "
                 "full-forward logits token-for-token (see "
                 "models/generate.py)")
+        if self.kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"kv_quant {self.kv_quant!r} not in ('none', 'int8')")
+        if self.param_quant not in ("none", "int8", "w8a8"):
+            raise ValueError(
+                f"param_quant {self.param_quant!r} not in "
+                "('none', 'int8', 'w8a8')")
+        if self.kv_quant != "none" and not self.decode:
+            raise ValueError(
+                "kv_quant is a decode-time knob (it shapes the K/V cache "
+                "layout); training/eval forward passes have no cache — "
+                "set it through llama_generate")
+        if self.param_quant != "none" and not self.decode:
+            raise ValueError(
+                "param_quant is inference-only (int8 kernels are not "
+                "differentiable); set it through llama_generate and "
+                "convert params with quantize_llama_params")
         if self.rope_scaling_kind not in ("none", "llama3"):
             raise ValueError(
                 f"rope_scaling_kind {self.rope_scaling_kind!r} not in "
@@ -344,6 +376,81 @@ def _tp_region_out_bwd(axis_name, _, g):
 _tp_region_out.defvjp(_tp_region_out_fwd, _tp_region_out_bwd)
 
 
+def _amax_quantize(x, eps: float = 1e-8):
+    """Dynamic symmetric int8 quantization along the LAST axis: returns
+    ``(q_int8, scale_f32)`` with ``scale = max(amax(|x|), eps) / 127``
+    and ``q = round(x / scale)``.  ``|q| <= 127`` by construction (the
+    amax element maps to exactly ±127), so no clip is needed — unlike
+    the offline kernel quantizer (quant.py), whose per-output-channel
+    scale divides elements from OTHER rows.  One definition for all four
+    runtime uses (activations, K/V writes, queries, probabilities)."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1, keepdims=True),
+                        eps) / 127.0
+    return jnp.round(x32 / scale).astype(jnp.int8), scale
+
+
+class QuantDense(nn.Module):
+    """Int8 linear layer for HBM-bound decode.
+
+    Params: ``kernel`` int8 ``[in, out]`` + ``scale`` f32 ``[out]``
+    (produced by :func:`bluefog_tpu.models.quant.quantize_llama_params`
+    from a trained ``nn.Dense`` kernel).  The per-output-channel scale is
+    constant along the contraction, so it commutes out of the matmul:
+    ``x @ (W_q * s) == (x @ W_q) * s`` exactly.
+
+    Two execution modes, measured on v5e (docs/performance.md round 4):
+
+    * ``act_quant=False`` (weight-only, ``param_quant='int8'``): the dot
+      runs in the compute dtype, so every weight element passes through
+      an int8->bf16 convert on its way into the MXU — HBM streams 1 B/el
+      but the convert path feeds matmuls at only ~280 GB/s effective.
+    * ``act_quant=True`` (W8A8, ``param_quant='w8a8'``): activations
+      quantize dynamically per token (one f32 amax scale per row — VPU
+      work linear in the TINY activation, not the weights) and the dot
+      runs natively s8 x s8 -> s32 on the MXU, which consumes int8
+      weights at ~590-690 GB/s — ~2x the weight-only mode's wall-clock.
+      Exact integer accumulation; the only extra rounding vs weight-only
+      is the activations' int8 snap.
+
+    ``out_f32`` returns f32 activations (the logits head).
+    """
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+    out_f32: bool = False
+    act_quant: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.zeros,
+                            (x.shape[-1], self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        if self.act_quant:
+            xq, xs = _amax_quantize(x)
+            y = lax.dot_general(
+                xq, kernel, (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = y.astype(jnp.float32) * xs * scale
+            return out if self.out_f32 else out.astype(self.dtype)
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.out_f32:
+            return y.astype(jnp.float32) * scale
+        return y * scale.astype(self.dtype)
+
+
+def _dense(cfg: LlamaConfig, feats: int, name: str):
+    """The projection layer the config asks for: trained-precision
+    ``nn.Dense`` or the int8 ``QuantDense`` (``param_quant='int8'``
+    weight-only / ``'w8a8'`` native-int8-matmul)."""
+    if cfg.param_quant != "none":
+        return QuantDense(feats, dtype=cfg.dtype,
+                          act_quant=cfg.param_quant == "w8a8", name=name)
+    return nn.Dense(feats, use_bias=False, dtype=cfg.dtype,
+                    param_dtype=jnp.float32, name=name)
+
+
 class Attention(nn.Module):
     cfg: LlamaConfig
 
@@ -352,9 +459,7 @@ class Attention(nn.Module):
         cfg = self.cfg
         b, t, _ = x.shape
         hd = cfg.head_dim
-        dense = lambda feats, name: nn.Dense(
-            feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
-            name=name)
+        dense = lambda feats, name: _dense(cfg, feats, name)
         # under TP this module runs per-shard: local head counts; wo's
         # partial output is psum'd below (Megatron column->row pattern,
         # entered through the 'f' operator so the backward is exact)
@@ -418,10 +523,6 @@ class Attention(nn.Module):
         cfg = self.cfg
         b, t, n_kv, hd = k.shape
         max_len = cfg.max_seq_len
-        ck = self.variable("cache", "cached_key", jnp.zeros,
-                           (b, max_len, n_kv, hd), cfg.dtype)
-        cv = self.variable("cache", "cached_value", jnp.zeros,
-                           (b, max_len, n_kv, hd), cfg.dtype)
         ci = self.variable("cache", "cache_index",
                            lambda: jnp.zeros((), jnp.int32))
         idx = ci.value
@@ -429,15 +530,131 @@ class Attention(nn.Module):
         q = rotary_embed(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = rotary_embed(k, positions, cfg.rope_theta, cfg.rope_scaling)
         zero = jnp.zeros((), idx.dtype)
-        k_all = lax.dynamic_update_slice(
-            ck.value, k.astype(cfg.dtype), (zero, idx, zero, zero))
-        v_all = lax.dynamic_update_slice(
-            cv.value, v.astype(cfg.dtype), (zero, idx, zero, zero))
-        ck.value, cv.value, ci.value = k_all, v_all, idx + t
-        # queries live at global positions [idx, idx+t); full_attention's
-        # q_offset places the causal mask there, which also excludes the
-        # cache's unwritten (zero) tail
-        return full_attention(q, k_all, v_all, causal=True, q_offset=idx)
+        if cfg.kv_quant == "int8":
+            # int8 cache, one f32 scale per (batch, position, kv_head)
+            # vector.  Both scales commute out of the contractions (the
+            # key scale is constant over head_dim, the value scale folds
+            # into the probabilities), so the dequant below fuses into
+            # the attention matmul reads — HBM streams int8.
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (b, max_len, n_kv, hd), jnp.int8)
+            cks = self.variable("cache", "cached_key_scale", jnp.zeros,
+                                (b, max_len, n_kv), jnp.float32)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (b, max_len, n_kv, hd), jnp.int8)
+            cvs = self.variable("cache", "cached_value_scale", jnp.zeros,
+                                (b, max_len, n_kv), jnp.float32)
+
+            kq, ks = _amax_quantize(k)
+            vq, vs = _amax_quantize(v)
+            ks, vs = ks[..., 0], vs[..., 0]  # scale per (b, t, kv_head)
+            kq_all = lax.dynamic_update_slice(ck.value, kq,
+                                              (zero, idx, zero, zero))
+            ks_all = lax.dynamic_update_slice(cks.value, ks,
+                                              (zero, idx, zero))
+            vq_all = lax.dynamic_update_slice(cv.value, vq,
+                                              (zero, idx, zero, zero))
+            vs_all = lax.dynamic_update_slice(cvs.value, vs,
+                                              (zero, idx, zero))
+            ck.value, cks.value = kq_all, ks_all
+            cv.value, cvs.value = vq_all, vs_all
+            ci.value = idx + t
+            if cfg.param_quant == "w8a8":
+                # fully-integer attention: both contractions run s8xs8
+                # on the MXU against the raw int8 cache — the cache
+                # streams at native-dot rates (~600 GB/s measured)
+                # instead of the ~280 GB/s convert-into-dot path
+                return _cached_attention_int8(q, kq_all, ks_all, vq_all,
+                                              vs_all, idx)
+            k_all = kq_all.astype(jnp.float32) * ks_all[..., None]
+            v_all = vq_all.astype(jnp.float32) * vs_all[..., None]
+        else:
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (b, max_len, n_kv, hd), cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (b, max_len, n_kv, hd), cfg.dtype)
+            k_all = lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (zero, idx, zero, zero))
+            v_all = lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (zero, idx, zero, zero))
+            ck.value, cv.value, ci.value = k_all, v_all, idx + t
+        # queries live at global positions [idx, idx+t); the causal mask
+        # there also excludes the cache's unwritten (zero) tail
+        return _cached_attention(q, k_all, v_all, idx)
+
+
+def _cached_attention(q, k_all, v_all, idx):
+    """Grouped-query attention over the whole K/V cache WITHOUT
+    materializing repeated K/V heads.
+
+    ``full_attention`` tiles K/V up to the query head count
+    (``_repeat_kv``) — fine for training where the score matmul
+    dominates, but decode is HBM-bound and the tiled cache multiplies
+    its per-step attention traffic by ``n_heads / n_kv_heads`` (4x for
+    Llama GQA).  Here the query heads reshape into ``[n_kv, group]``
+    and both contractions run against the cache at its NATIVE kv-head
+    count; any dequantization expression feeding ``k_all``/``v_all``
+    (the int8 cache path) fuses into the dot operand reads.
+
+    q: [B, T, n_q, D] (global positions ``idx + arange(T)``),
+    k_all/v_all: [B, S, n_kv, D].  Returns [B, T, n_q, D] in q's dtype.
+    """
+    b, t, n_q, d = q.shape
+    s, n_kv = k_all.shape[1], k_all.shape[2]
+    rep = n_q // n_kv
+    q5 = q.reshape(b, t, n_kv, rep, d).astype(jnp.float32)
+    scores = jnp.einsum("btkrd,bskd->bkrts", q5,
+                        k_all.astype(jnp.float32)) * (1.0 / d ** 0.5)
+    q_pos = idx + jnp.arange(t)
+    mask = jnp.arange(s)[None, :] <= q_pos[:, None]  # [T, S]
+    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    # every query row sees at least its own key (just written), so no
+    # fully-masked-row guard is needed
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkrts,bskd->btkrd", p, v_all.astype(jnp.float32))
+    return out.reshape(b, t, n_q, d).astype(q.dtype)
+
+
+def _cached_attention_int8(q, kq_all, ks_all, vq_all, vs_all, idx):
+    """Grouped-query cached attention with BOTH contractions as native
+    s8 x s8 -> s32 MXU dots (the ``param_quant='w8a8'`` +
+    ``kv_quant='int8'`` decode path).
+
+    The per-vector cache scales commute exactly: the key scale is
+    constant along the head_dim contraction so it multiplies the score
+    columns afterwards; the value scale varies along the position
+    contraction so it folds INTO the probabilities before they are
+    dynamically quantized (one amax scale per row — the same trick
+    QuantDense plays on activations).  Rounding beyond the cache's own
+    int8 snap: the queries' and probabilities' per-row int8 quant.
+
+    q: [B, T, n_q, D] (positions ``idx + arange(T)``),
+    kq_all/vq_all: int8 [B, S, n_kv, D], ks_all/vs_all: f32 [B, S, n_kv].
+    """
+    b, t, n_q, d = q.shape
+    s, n_kv = kq_all.shape[1], kq_all.shape[2]
+    rep = n_q // n_kv
+    qq, qs = _amax_quantize(q.reshape(b, t, n_kv, rep, d))
+    s32 = jnp.einsum("btkrd,bskd->bkrts", qq, kq_all,
+                     preferred_element_type=jnp.int32)
+    # scales: q per row [B,T,KV,R,1] -> [B,KV,R,T,1]; k per position
+    # [B,S,KV] -> [B,KV,1,1,S]
+    scores = (s32.astype(jnp.float32)
+              * jnp.transpose(qs, (0, 2, 3, 1, 4))
+              * jnp.transpose(ks_all, (0, 2, 1))[:, :, None, None, :]
+              * (1.0 / d ** 0.5))
+    q_pos = idx + jnp.arange(t)
+    mask = jnp.arange(s)[None, :] <= q_pos[:, None]  # [T, S]
+    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)  # [B,KV,R,T,S]
+    pv = p * jnp.transpose(vs_all, (0, 2, 1))[:, :, None, None, :]
+    # eps far below any realistic row amax: a probability row sums to 1,
+    # so amax >= 1/S — the tiny eps only guards fully-padded rows
+    pq, ps = _amax_quantize(pv, eps=1e-30)
+    o32 = jnp.einsum("bkrts,bskd->btkrd", pq, vq_all,
+                     preferred_element_type=jnp.int32)
+    out = o32.astype(jnp.float32) * jnp.transpose(ps, (0, 3, 1, 2, 4))
+    return out.reshape(b, t, n_q, d).astype(q.dtype)
 
 
 class FeedForward(nn.Module):
@@ -446,9 +663,7 @@ class FeedForward(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        dense = lambda feats, name: nn.Dense(
-            feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
-            name=name)
+        dense = lambda feats, name: _dense(cfg, feats, name)
         tp = cfg.tp_axis is not None and cfg.tp_size > 1
         if tp:
             x = _tp_region_in(x, cfg.tp_axis)
@@ -709,9 +924,19 @@ class Llama(nn.Module):
             # the other T-1 head matmuls and the [B, T, vocab] logits
             # buffer (at 8k prompt x 128k vocab that is ~4 GB of f32)
             x = x[:, -1:]
-        head_dtype = jnp.float32 if cfg.logits_dot_in_fp32 else cfg.dtype
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=head_dtype,
-                          param_dtype=jnp.float32, name="output")(x)
+        if cfg.param_quant != "none":
+            # int8 head: HBM streams the int8 kernel, the per-channel
+            # scale lands in f32 — the logits keep f32 dynamic range
+            # around int8-rounded products
+            logits = QuantDense(cfg.vocab_size, dtype=cfg.dtype,
+                                out_f32=True,
+                                act_quant=cfg.param_quant == "w8a8",
+                                name="output")(x)
+        else:
+            head_dtype = jnp.float32 if cfg.logits_dot_in_fp32 else cfg.dtype
+            logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                              dtype=head_dtype, param_dtype=jnp.float32,
+                              name="output")(x)
         return logits.astype(jnp.float32)
 
 
@@ -897,6 +1122,13 @@ def llama_param_specs(params_or_shapes, rank_axis: Optional[str] = "bf",
         # that model.init returned); the produced specs are for the
         # rank-major global arrays, so the rank axis is prepended here
         nd = len(leaf.shape)
+        leaf_name = str(getattr(path[-1], "key",
+                                getattr(path[-1], "name", path[-1])))
+        # QuantDense per-output-channel scales ([.., out]) shard exactly
+        # like their kernel's OUTPUT dim: over tp for column-parallel
+        # layers, replicated for row-parallel ones (whose tp-sharded dim
+        # is the input)
+        is_scale = leaf_name == "scale"
         dims = [None] * nd
         # scanned decoder stack: leading dim is the layer axis
         if pp_axis is not None and "/layers/" in tagged and nd >= 1:
@@ -904,10 +1136,12 @@ def llama_param_specs(params_or_shapes, rank_axis: Optional[str] = "bf",
         if "/moe_ffn/" in tagged:
             if ep_axis is not None and "/router/" not in tagged and nd >= 3:
                 dims[-3] = ep_axis  # [.., E, in, out]: shard E
-        elif any(f"/{k}/" in tagged for k in column) and nd >= 2:
+        elif any(f"/{k}/" in tagged for k in column) \
+                and (nd >= 2 or (is_scale and nd >= 1)):
             if tp_axis is not None:
                 dims[-1] = tp_axis
-        elif any(f"/{k}/" in tagged for k in row) and nd >= 2:
+        elif any(f"/{k}/" in tagged for k in row) and nd >= 2 \
+                and not is_scale:
             if tp_axis is not None:
                 dims[-2] = tp_axis
         while dims and dims[-1] is None:  # canonical: no trailing Nones

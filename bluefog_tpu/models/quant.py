@@ -1,0 +1,104 @@
+"""Weight-only int8 quantization for HBM-bound decode.
+
+Decode streams every parameter once per emitted token, so bytes are
+time: int8 kernels halve the traffic vs bf16 (quarter vs f32) and RAISE
+the analytic tokens/s ceiling by the same factor.  This module converts
+a trained full-precision Llama param tree into the layout
+``QuantDense`` (models/llama.py) consumes: each projection kernel
+``W [.., in, out]`` becomes ``round(W / s)`` in int8 with one f32 scale
+per output channel ``s = max(|W|, axis=in) / 127``.  Per-output-channel
+scaling is exact through the matmul (``x @ (W_q * s) == (x @ W_q) * s``)
+— the only rounding is the int8 snap itself, ~0.4% RMS per weight.
+
+The reference framework is training-only (SURVEY.md §2: no inference
+stack); this is part of the beyond-parity generation path
+(models/generate.py).
+
+Usage::
+
+    qvars = quantize_llama_params(variables)   # once, offline
+    out = llama_generate(qvars, cfg, prompt, n, weight_quant="int8")
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["quantize_llama_params", "is_quantized_params",
+           "QUANT_KERNELS"]
+
+# Modules whose "kernel" param quantizes: all seven projection kernels
+# plus the logits head.  Embeddings stay full precision — decode gathers
+# one row per token, so their HBM traffic is negligible; norm scales are
+# vectors.  MoE expert tensors are excluded because cached decode does
+# not support MoE (models/generate.py).
+QUANT_KERNELS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3", "output")
+
+
+def _quantize_kernel(w: jax.Array):
+    """int8 kernel + per-output-channel f32 scale for ``w [.., in, out]``
+    (a leading scan-layer axis quantizes per layer automatically: the
+    reduction is over the input axis only)."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale[..., None, :]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def quantize_llama_params(variables):
+    """Convert a trained Llama param tree to the ``param_quant='int8'``
+    layout.
+
+    Accepts either ``{"params": tree}`` (as returned by ``model.init`` /
+    the HF importer) or the bare param tree, and returns the same
+    structure with every ``{"kernel": W}`` under a :data:`QUANT_KERNELS`
+    module replaced by ``{"kernel": int8, "scale": f32[out]}``.  Works
+    for both unrolled (``layer_i/...``) and scanned (``layers/block``)
+    layouts — the scale reduction is over the input axis only, so a
+    leading ``[n_layers]`` axis yields per-layer scales, matching what
+    ``nn.scan`` expects for the per-layer ``scale`` param.
+    """
+    wrapped = isinstance(variables, dict) and "params" in variables
+    params = variables["params"] if wrapped else variables
+
+    def walk(tree):
+        out = {}
+        for name, sub in tree.items():
+            if isinstance(sub, dict):
+                if name in QUANT_KERNELS and set(sub) == {"kernel"}:
+                    q, scale = _quantize_kernel(sub["kernel"])
+                    out[name] = {"kernel": q, "scale": scale}
+                else:
+                    out[name] = walk(sub)
+            else:
+                out[name] = sub
+        return out
+
+    qparams = walk(dict(params))
+    if wrapped:
+        out = dict(variables)
+        out["params"] = qparams
+        return out
+    return qparams
+
+
+def is_quantized_params(variables) -> bool:
+    """True if the tree already carries the int8 layout (any
+    :data:`QUANT_KERNELS` module with both ``kernel`` and ``scale``)."""
+    params = variables.get("params", variables) \
+        if isinstance(variables, dict) else variables
+    found = [False]
+
+    def walk(tree):
+        for name, sub in tree.items():
+            if isinstance(sub, dict):
+                if name in QUANT_KERNELS and "scale" in sub \
+                        and "kernel" in sub:
+                    found[0] = True
+                    return
+                walk(sub)
+
+    walk(params)
+    return found[0]

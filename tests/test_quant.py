@@ -1,0 +1,195 @@
+"""Inference quantization: int8 K/V cache + weight-only int8 decode.
+
+Contract under test (models/quant.py, models/llama.py QuantDense /
+kv_quant): quantized decode must track the full-precision decode — same
+greedy tokens on well-separated logits, logits within a small tolerance —
+while the cache/param trees actually carry int8 (the whole point is HBM
+bytes).  The reference framework is training-only, so this surface has
+no reference counterpart; the contract is internal consistency with our
+own full-precision path.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bluefog_tpu import models
+from bluefog_tpu.models import (LlamaConfig, llama_generate,
+                                quantize_llama_params)
+from bluefog_tpu.models.generate import init_cache
+from bluefog_tpu.models.quant import QUANT_KERNELS, is_quantized_params
+
+
+@pytest.fixture(scope="module")
+def trained():
+    cfg = LlamaConfig.tiny(max_seq_len=96)
+    model = models.Llama(cfg)
+    variables = model.init(jax.random.PRNGKey(7),
+                           jnp.zeros((2, 8), jnp.int32))
+    prompt = jnp.asarray(
+        np.random.RandomState(3).randint(0, cfg.vocab_size, (2, 12)),
+        jnp.int32)
+    return cfg, variables, prompt
+
+
+def _logits_one_step(variables, cfg, prompt, **quant):
+    """Prefill the prompt and return the next-token logits by running
+    generate with max_new_tokens=1 through the model's decode apply."""
+    kv = quant.get("kv_quant", "none")
+    wq = quant.get("weight_quant", "none")
+    from bluefog_tpu.models.generate import _decode_cfg
+
+    dcfg = _decode_cfg(cfg, prompt.shape[1] + 1, kv_quant=kv,
+                       weight_quant=wq)
+    model = models.Llama(dcfg)
+    cache = init_cache(dcfg, prompt.shape[0], prompt.shape[1] + 1,
+                       kv_quant=kv)
+    logits, _ = model.apply({**variables, "cache": cache}, prompt,
+                            mutable=["cache"])
+    return logits[:, -1]
+
+
+def test_quantize_params_structure(trained):
+    cfg, variables, _ = trained
+    qvars = quantize_llama_params(variables)
+    assert is_quantized_params(qvars)
+    assert not is_quantized_params(variables)
+    wq = qvars["params"]["layer_0"]["attention"]["wq"]
+    assert wq["kernel"].dtype == jnp.int8
+    assert wq["scale"].dtype == jnp.float32
+    assert wq["scale"].shape == (wq["kernel"].shape[-1],)
+    # embeddings stay full precision
+    emb = qvars["params"]["tok_embeddings"]["embedding"]
+    assert emb.dtype == jnp.float32
+    # dequantized kernel reproduces the original within one int8 step
+    orig = variables["params"]["layer_0"]["attention"]["wq"]["kernel"]
+    deq = wq["kernel"].astype(jnp.float32) * wq["scale"][None, :]
+    assert float(jnp.max(jnp.abs(deq - orig))) <= \
+        float(jnp.max(wq["scale"])) * 0.5 + 1e-8
+
+
+def test_quantize_params_scanned_layout():
+    cfg = LlamaConfig.tiny(scan_layers=True)
+    variables = models.Llama(cfg).init(jax.random.PRNGKey(0),
+                                       jnp.zeros((1, 8), jnp.int32))
+    qvars = quantize_llama_params(variables)
+    wq = qvars["params"]["layers"]["block"]["attention"]["wq"]
+    assert wq["kernel"].dtype == jnp.int8
+    # per-layer scales: leading layer axis preserved
+    assert wq["scale"].shape == (cfg.n_layers, wq["kernel"].shape[-1])
+
+
+def test_kv_int8_cache_is_int8(trained):
+    cfg, _, _ = trained
+    cache = init_cache(cfg, 2, 32, kv_quant="int8")
+    leaves = jax.tree_util.tree_leaves_with_path(cache)
+    kinds = {str(p[-1].key): l.dtype for p, l in leaves}
+    assert kinds["cached_key"] == jnp.int8
+    assert kinds["cached_value"] == jnp.int8
+    assert kinds["cached_key_scale"] == jnp.float32
+
+
+def test_kv_int8_logits_close(trained):
+    cfg, variables, prompt = trained
+    ref = _logits_one_step(variables, cfg, prompt)
+    got = _logits_one_step(variables, cfg, prompt, kv_quant="int8")
+    # int8 per-vector K/V: logits drift bounded by the quant noise
+    assert float(jnp.max(jnp.abs(got - ref))) < 0.15 * (
+        1.0 + float(jnp.max(jnp.abs(ref))))
+
+
+@pytest.mark.parametrize("mode", ["int8", "w8a8"])
+def test_weight_quant_logits_close(trained, mode):
+    cfg, variables, prompt = trained
+    qvars = quantize_llama_params(variables)
+    ref = _logits_one_step(variables, cfg, prompt)
+    got = _logits_one_step(qvars, cfg, prompt, weight_quant=mode)
+    assert float(jnp.max(jnp.abs(got - ref))) < 0.15 * (
+        1.0 + float(jnp.max(jnp.abs(ref))))
+
+
+@pytest.mark.parametrize("mode", ["int8", "w8a8"])
+def test_quant_generate_matches_full_precision_tokens(trained, mode):
+    """Covers the full quantized decode per mode — for w8a8 that
+    includes QuantDense's dynamic activation quant AND the
+    fully-integer attention (_cached_attention_int8, both s8xs8
+    contractions with the scale transposes)."""
+    cfg, variables, prompt = trained
+    full = llama_generate(variables, cfg, prompt, 16)
+    qvars = quantize_llama_params(variables)
+    both = llama_generate(qvars, cfg, prompt, 16, kv_quant="int8",
+                          weight_quant=mode)
+    full, both = np.asarray(full), np.asarray(both)
+    assert full.shape == both.shape
+    # prompts echo exactly; greedy tokens track closely (quant noise can
+    # flip near-ties, so require agreement on the first steps and a high
+    # overall match instead of exact equality)
+    np.testing.assert_array_equal(full[:, :prompt.shape[1]],
+                                  both[:, :prompt.shape[1]])
+    gen_f = full[:, prompt.shape[1]:]
+    gen_q = both[:, prompt.shape[1]:]
+    assert (gen_f[:, 0] == gen_q[:, 0]).all()
+    # beyond the first step the rollout is chaotic on this random-init
+    # model (one near-tie flip changes all later context), so the
+    # agreement fraction mostly measures WHEN the first flip lands;
+    # logits closeness per mode is asserted separately above
+    assert (gen_f == gen_q).mean() > 0.5
+
+
+def test_weight_quant_tree_mismatch_raises(trained):
+    cfg, variables, prompt = trained
+    with pytest.raises(ValueError, match="quantize_llama_params"):
+        llama_generate(variables, cfg, prompt, 2, weight_quant="int8")
+    qvars = quantize_llama_params(variables)
+    with pytest.raises(ValueError, match="mismatched"):
+        llama_generate(qvars, cfg, prompt, 2)
+
+
+def test_quant_config_guards():
+    with pytest.raises(ValueError, match="decode"):
+        LlamaConfig.tiny(kv_quant="int8")
+    with pytest.raises(ValueError, match="inference-only"):
+        LlamaConfig.tiny(param_quant="int8")
+    with pytest.raises(ValueError, match="kv_quant"):
+        LlamaConfig.tiny(kv_quant="fp4", decode=True)
+
+
+def test_tp_sharded_quant_decode(trained):
+    """weight_quant + kv_quant compose with the tp-sharded decode path:
+    per-output-channel scales shard with their kernel's output dim
+    (llama_param_specs), and the sharded program reproduces the
+    replicated one's tokens."""
+    cfg0, _, _ = trained
+    cfg = dataclasses.replace(cfg0, tp_axis="tp", tp_size=2)
+    model = models.Llama(dataclasses.replace(cfg0))
+    variables = model.init(jax.random.PRNGKey(7),
+                           jnp.zeros((2, 8), jnp.int32))
+    prompt = jnp.asarray(
+        np.random.RandomState(3).randint(0, cfg.vocab_size, (2, 12)),
+        jnp.int32)
+    qvars = quantize_llama_params(variables)
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    ref = llama_generate(qvars, cfg0, prompt, 8, kv_quant="int8",
+                         weight_quant="int8")
+    got = llama_generate(qvars, cfg, prompt, 8, mesh=mesh,
+                         kv_quant="int8", weight_quant="int8")
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_w8a8_attention_int8_logits_close(trained):
+    """_cached_attention_int8 in isolation (multi-token prefill + one
+    step): w8a8 + int8 kv logits track the fully-unquantized path."""
+    cfg, variables, prompt = trained
+    qvars = quantize_llama_params(variables)
+    ref = _logits_one_step(variables, cfg, prompt)
+    got = _logits_one_step(qvars, cfg, prompt, kv_quant="int8",
+                           weight_quant="w8a8")
+    err = float(jnp.max(jnp.abs(got - ref)))
+    assert err < 0.2 * (1.0 + float(jnp.max(jnp.abs(ref)))), err
+    # argmax (the sampled token) must agree
+    assert (jnp.argmax(got, -1) == jnp.argmax(ref, -1)).all()
