@@ -22,6 +22,7 @@ from bluefog_tpu.models.llama import (
     llama_circular_layout,
     llama_param_specs,
     llama_pp_loss_fn,
+    vocab_parallel_xent,
 )
 from bluefog_tpu.models.generate import init_cache, llama_generate
 from bluefog_tpu.models.quant import quantize_llama_params
@@ -48,4 +49,5 @@ __all__ = [
     "llama_generate",
     "init_cache",
     "quantize_llama_params",
+    "vocab_parallel_xent",
 ]

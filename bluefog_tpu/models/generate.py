@@ -43,11 +43,16 @@ def _decode_cfg(cfg: LlamaConfig, max_len: int, keep_tp: bool = False,
             "a cached decode (one token at a time) would not reproduce "
             "the full-forward logits token-for-token")
     tp = {} if keep_tp else {"tp_axis": None, "tp_size": 1}
+    # vocab_parallel is a training-time memory layout (it shards the
+    # optimizer-state-bearing vocab matrices); decode clears it like the
+    # other training-only knobs — the param TREE is identical, so a
+    # vocab_parallel-trained checkpoint serves through the replicated
+    # head directly.
     return dataclasses.replace(
         cfg, decode=True, max_seq_len=max_len, attn_mode="full",
         attn_impl="xla", sp_axis=None, ep_axis=None, ep_size=1,
         remat=False, remat_policy="none", kv_quant=kv_quant,
-        param_quant=weight_quant, **tp)
+        param_quant=weight_quant, vocab_parallel=False, **tp)
 
 
 def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int,

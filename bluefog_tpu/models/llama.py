@@ -156,6 +156,17 @@ class LlamaConfig:
     # training stays full precision.
     kv_quant: str = "none"  # none | int8
     param_quant: str = "none"  # none | int8
+    # Megatron-style vocab parallelism: the token embedding shards its
+    # VOCAB rows and the logits head its VOCAB columns over ``tp_axis``,
+    # so the two [128k x 4096] matrices stop being replicated per chip —
+    # at Llama-3-8B scale they are ~4.2 GB of f32 params per chip (plus
+    # the same again in momentum and gradients), the difference between
+    # fitting a 16 GB v5e chip and not (benchmarks/llama_8b_structural).
+    # The model then RETURNS VOCAB-SHARDED logits [B, T, V/tp]; train
+    # with ``vocab_parallel_xent`` (exact vocab-parallel cross-entropy,
+    # one pmax + two psums per step).  Training-only: decode keeps the
+    # replicated head (no optimizer state there to dominate memory).
+    vocab_parallel: bool = False
 
     def __post_init__(self):
         if self.decode and self.attn_mode != "full":
@@ -187,6 +198,20 @@ class LlamaConfig:
                 "param_quant is inference-only (int8 kernels are not "
                 "differentiable); set it through llama_generate and "
                 "convert params with quantize_llama_params")
+        if self.vocab_parallel:
+            if self.tp_size <= 1 or self.tp_axis is None:
+                raise ValueError("vocab_parallel requires tensor "
+                                 "parallelism (tp_axis + tp_size > 1)")
+            if self.vocab_size % self.tp_size:
+                raise ValueError(
+                    f"vocab_size ({self.vocab_size}) must divide by "
+                    f"tp_size ({self.tp_size}) for vocab_parallel")
+            if self.decode:
+                raise ValueError(
+                    "vocab_parallel is a training-time memory layout "
+                    "(it shards the optimizer-state-bearing vocab "
+                    "matrices); decode keeps the replicated head — drop "
+                    "vocab_parallel from the decode config")
         if self.rope_scaling_kind not in ("none", "llama3"):
             raise ValueError(
                 f"rope_scaling_kind {self.rope_scaling_kind!r} not in "
@@ -449,6 +474,81 @@ def _dense(cfg: LlamaConfig, feats: int, name: str):
                           act_quant=cfg.param_quant == "w8a8", name=name)
     return nn.Dense(feats, use_bias=False, dtype=cfg.dtype,
                     param_dtype=jnp.float32, name=name)
+
+
+class VocabParallelEmbed(nn.Module):
+    """Token embedding with VOCAB rows sharded over ``tp_axis``.
+
+    Each shard holds ``vocab/tp`` rows; out-of-range token ids look up a
+    clamped row and are masked to zero, and the shards' partial results
+    merge through ONE psum (the Megatron ``g`` operator, so the
+    backward is identity and each shard's table gradient is exactly its
+    own rows' — gradient parity in tests/test_vocab_parallel.py).
+    Param path matches ``nn.Embed`` (``embedding``), so checkpoints move
+    freely between layouts (the global array keeps the full
+    ``[vocab, dim]`` shape; sharding happens in ``llama_param_specs``).
+    """
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        v_local = cfg.vocab_size // cfg.tp_size
+        table = self.param(
+            "embedding", nn.initializers.variance_scaling(
+                1.0, "fan_in", "normal", out_axis=0),
+            (v_local, cfg.dim), jnp.float32)
+        lo = lax.axis_index(cfg.tp_axis) * v_local
+        local = tokens - lo
+        valid = (local >= 0) & (local < v_local)
+        x = jnp.take(table.astype(cfg.dtype),
+                     jnp.clip(local, 0, v_local - 1), axis=0)
+        x = jnp.where(valid[..., None], x, 0)
+        return _tp_region_out(x, cfg.tp_axis)
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(1,))
+def _pmax_nograd(x, axis_name):
+    """``lax.pmax`` with a zero tangent (pmax has no differentiation
+    rule in JAX; as the logsumexp shift its gradient is exactly zero
+    anyway — the shift cancels in ``logz - tlogit``)."""
+    return lax.pmax(x, axis_name)
+
+
+@_pmax_nograd.defjvp
+def _pmax_nograd_jvp(axis_name, primals, tangents):
+    (x,) = primals
+    out = lax.pmax(x, axis_name)
+    return out, jnp.zeros_like(out)
+
+
+def vocab_parallel_xent(local_logits, targets, axis_name: str):
+    """Exact next-token cross-entropy over VOCAB-SHARDED logits.
+
+    ``local_logits``: ``[..., vocab/tp]`` (this shard's columns, in
+    shard-index order — what a ``vocab_parallel`` Llama returns);
+    ``targets``: ``[...]`` GLOBAL token ids.  Communicates one ``pmax``
+    (stop-gradded — the standard logsumexp shift, exact either way) and
+    two psums via the Megatron ``g`` operator so the backward stays
+    per-shard (each shard's logit cotangent is the usual
+    ``softmax - onehot`` restricted to its columns).  Every shard
+    returns the IDENTICAL scalar mean loss, matching this framework's
+    replicated-loss SPMD convention (optim/functional.py).
+    """
+    v_local = local_logits.shape[-1]
+    logits32 = local_logits.astype(jnp.float32)
+    m = _pmax_nograd(jnp.max(logits32, -1), axis_name)
+    se = _tp_region_out(jnp.sum(jnp.exp(logits32 - m[..., None]), -1),
+                        axis_name)
+    logz = m + jnp.log(se)
+    lo = lax.axis_index(axis_name) * v_local
+    local = targets - lo
+    valid = (local >= 0) & (local < v_local)
+    tlogit = jnp.take_along_axis(
+        logits32, jnp.clip(local, 0, v_local - 1)[..., None], -1)[..., 0]
+    tlogit = _tp_region_out(jnp.where(valid, tlogit, 0.0), axis_name)
+    return jnp.mean(logz - tlogit)
 
 
 class Attention(nn.Module):
@@ -883,13 +983,19 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
-        """tokens: [B, T_local] int32 -> logits [B, T_local, vocab] f32."""
+        """tokens: [B, T_local] int32 -> logits [B, T_local, vocab] f32
+        (with ``cfg.vocab_parallel``: [B, T_local, vocab/tp] — this
+        shard's columns; train against ``vocab_parallel_xent``)."""
         cfg = self.cfg
         assert tokens.shape[1] <= cfg.max_seq_len, (
             f"sequence shard {tokens.shape[1]} exceeds max_seq_len "
             f"{cfg.max_seq_len}")
-        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
-                     param_dtype=jnp.float32, name="tok_embeddings")(tokens)
+        if cfg.vocab_parallel:
+            x = VocabParallelEmbed(cfg, name="tok_embeddings")(tokens)
+        else:
+            x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                         param_dtype=jnp.float32,
+                         name="tok_embeddings")(tokens)
         policy = _remat_policies()[cfg.remat_policy]
         if cfg.scan_layers:
             # one compiled block, scanned n_layers times; params get a
@@ -932,6 +1038,17 @@ class Llama(nn.Module):
                                 out_f32=True,
                                 act_quant=cfg.param_quant == "w8a8",
                                 name="output")(x)
+        elif cfg.vocab_parallel:
+            # column-parallel over VOCAB: each shard emits its own
+            # logits columns [B, T, vocab/tp] — NOT psum-merged (the
+            # full matrix would be the memory the layout exists to
+            # avoid); train against vocab_parallel_xent.  x enters the
+            # parallel region through f so the backward psum is exact.
+            head_dtype = jnp.float32 if cfg.logits_dot_in_fp32 else cfg.dtype
+            logits = nn.Dense(cfg.vocab_size // cfg.tp_size,
+                              use_bias=False, dtype=head_dtype,
+                              param_dtype=jnp.float32, name="output")(
+                                  _tp_region_in(x, cfg.tp_axis))
         else:
             head_dtype = jnp.float32 if cfg.logits_dot_in_fp32 else cfg.dtype
             logits = nn.Dense(cfg.vocab_size, use_bias=False,
@@ -1009,11 +1126,16 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
     # so the pp path cannot diverge from the plain model's math
     block = Block(cfg)
     final_norm = RMSNorm(cfg.norm_eps)
-    embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
-                     param_dtype=jnp.float32)
     head_dtype = jnp.float32 if cfg.logits_dot_in_fp32 else cfg.dtype
-    head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=head_dtype,
-                    param_dtype=jnp.float32)
+    if cfg.vocab_parallel:
+        embed = VocabParallelEmbed(cfg)
+        head = nn.Dense(cfg.vocab_size // cfg.tp_size, use_bias=False,
+                        dtype=head_dtype, param_dtype=jnp.float32)
+    else:
+        embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                         param_dtype=jnp.float32)
+        head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=head_dtype,
+                        param_dtype=jnp.float32)
     want_aux = cfg.n_experts > 0 and cfg.moe_aux_weight > 0.0
 
     def loss_fn(params, batch):
@@ -1074,9 +1196,16 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
         # exactly once across the axis and the train step's pp psum
         # restores the replicated update.
         h = final_norm.apply({"params": p["norm"]}, h)
-        logits = head.apply({"params": p["output"]}, h).astype(jnp.float32)
-        loss = jnp.mean(
-            optax.softmax_cross_entropy_with_integer_labels(logits, tgt))
+        if cfg.vocab_parallel:
+            hl = _tp_region_in(h, cfg.tp_axis)
+            logits = head.apply({"params": p["output"]},
+                                hl).astype(jnp.float32)
+            loss = vocab_parallel_xent(logits, tgt, cfg.tp_axis)
+        else:
+            logits = head.apply({"params": p["output"]},
+                                h).astype(jnp.float32)
+            loss = jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(logits, tgt))
         stage = lax.axis_index(pp_axis)
         loss = jnp.where(stage == n_stages - 1, loss, 0.0)
         if want_aux:
@@ -1095,7 +1224,8 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
 def llama_param_specs(params_or_shapes, rank_axis: Optional[str] = "bf",
                       tp_axis: Optional[str] = "tp",
                       ep_axis: Optional[str] = "ep",
-                      pp_axis: Optional[str] = None):
+                      pp_axis: Optional[str] = None,
+                      vocab_axis: Optional[str] = None):
     """PartitionSpec tree for rank-major Llama params under model
     parallelism: column-parallel kernels (wq/wk/wv/w1/w3) shard their
     OUTPUT (last) dim over ``tp_axis``, row-parallel kernels (wo/w2)
@@ -1105,7 +1235,10 @@ def llama_param_specs(params_or_shapes, rank_axis: Optional[str] = "bf",
     layout) every leaf under the scanned block additionally shards its
     leading ``[n_layers]`` axis over the pipeline axis, so each stage
     holds only its own layers.  The router and everything outside the
-    decoder stack (embeddings, final norm, logits head) stay replicated.
+    decoder stack (embeddings, final norm, logits head) stay replicated
+    — unless ``vocab_axis`` is given (``cfg.vocab_parallel`` models):
+    then the embedding shards its VOCAB rows (dim 0) and the logits
+    head its VOCAB columns (last dim) over that axis.
     Works for both unrolled and scanned layouts (the kernel rank decides
     where the sharded dim sits).  Feed the result to
     ``optim.functional.build_train_step(param_specs=...)``."""
@@ -1133,7 +1266,12 @@ def llama_param_specs(params_or_shapes, rank_axis: Optional[str] = "bf",
         # scanned decoder stack: leading dim is the layer axis
         if pp_axis is not None and "/layers/" in tagged and nd >= 1:
             dims[0] = pp_axis
-        if "/moe_ffn/" in tagged:
+        if vocab_axis is not None and "/tok_embeddings/" in tagged \
+                and nd >= 2:
+            dims[0] = vocab_axis  # [V, D]: shard the vocab rows
+        elif vocab_axis is not None and "/output/" in tagged and nd >= 1:
+            dims[-1] = vocab_axis  # kernel [D, V] / scale [V]: columns
+        elif "/moe_ffn/" in tagged:
             if ep_axis is not None and "/router/" not in tagged and nd >= 3:
                 dims[-3] = ep_axis  # [.., E, in, out]: shard E
         elif any(f"/{k}/" in tagged for k in column) \

@@ -1,0 +1,173 @@
+"""Vocab parallelism: Megatron-style vocab-sharded embedding + logits
+head with an exact vocab-parallel cross-entropy.
+
+At Llama-3-8B scale the [128k x 4096] embedding and head are ~4.2 GB of
+f32 params PER CHIP when replicated (plus the same in momentum and
+gradients) — the difference between the 8B config fitting a 16 GB v5e
+chip and not (benchmarks/llama_8b_structural.py).  These tests pin the
+layout to the unsharded model: identical loss and identical gradients
+for the same global params (the sharding is a layout, not a different
+model), in both the plain-stack and pipeline-parallel loss builders.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bluefog_tpu import models
+from bluefog_tpu.models import vocab_parallel_xent
+from bluefog_tpu.models.llama import llama_param_specs
+from bluefog_tpu.optim import functional as F
+
+N_BF, N_TP = 4, 2
+B, T = 2, 16
+
+
+@pytest.fixture
+def mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(N_BF, N_TP),
+                ("bf", "tp"))
+
+
+def _models():
+    cfg1 = models.LlamaConfig.tiny(dtype=jnp.float32)
+    cfg2 = models.LlamaConfig.tiny(dtype=jnp.float32, tp_axis="tp",
+                                   tp_size=N_TP, vocab_parallel=True)
+    return models.Llama(cfg1), models.Llama(cfg2), cfg1
+
+
+def test_vocab_parallel_requires_tp():
+    with pytest.raises(ValueError, match="tensor"):
+        models.LlamaConfig.tiny(vocab_parallel=True)
+    with pytest.raises(ValueError, match="decode"):
+        models.LlamaConfig.tiny(tp_axis="tp", tp_size=2,
+                                vocab_parallel=True, decode=True)
+
+
+def test_vocab_parallel_specs(mesh):
+    _, _, cfg = _models()
+    variables = models.Llama(cfg).init(jax.random.PRNGKey(0),
+                                       jnp.zeros((B, T), jnp.int32))
+    specs = llama_param_specs(variables, vocab_axis="tp")
+    flat = jax.tree_util.tree_flatten_with_path(specs)[0]
+    by_name = {"/".join(str(getattr(p, "key", p)) for p in path): spec
+               for path, spec in flat}
+    emb = next(v for k, v in by_name.items() if "tok_embeddings" in k)
+    head = next(v for k, v in by_name.items() if "output" in k)
+    assert emb == P("bf", "tp")        # [V, D]: vocab rows sharded
+    assert head == P("bf", None, "tp")  # [D, V]: vocab columns sharded
+
+
+def test_vocab_parallel_loss_and_grads_match_single_shard(mesh):
+    """Loss AND gradients through the vocab-parallel model (sharded
+    embedding lookup -> tp blocks -> sharded head ->
+    vocab_parallel_xent) equal the unsharded model's CE for the same
+    global params.  Guards the f/g operator placement in
+    VocabParallelEmbed / the head / the xent psums (a bare psum would
+    come back tp_size-scaled)."""
+    m1, m2, cfg = _models()
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (N_BF, B, T), 0,
+                                cfg.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (N_BF, B, T), 0,
+                                 cfg.vocab_size)
+    variables = m1.init(jax.random.PRNGKey(1), tokens[0])
+    specs = llama_param_specs(variables, vocab_axis="tp")
+    params = F.rank_major(variables, mesh, specs=specs)
+
+    def sharded_loss(p, toks, tgt):
+        logits = m2.apply(p, toks)  # [B, T, V/tp]
+        return vocab_parallel_xent(logits, tgt, "tp")
+
+    def ref_loss(p, toks, tgt):
+        logits = m1.apply(p, toks)
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, tgt))
+
+    def grad_shard(p, toks, tgt):
+        local = jax.tree.map(lambda l: l[0], p)
+        loss, g = jax.value_and_grad(sharded_loss)(local, toks[0], tgt[0])
+        return loss[None], jax.tree.map(lambda l: l[None], g)
+
+    sm = jax.shard_map(grad_shard, mesh=mesh,
+                       in_specs=(specs, P("bf"), P("bf")),
+                       out_specs=(P("bf"), specs), check_vma=False)
+    sharding = NamedSharding(mesh, P("bf"))
+    loss_tp, g_tp = jax.jit(sm)(params, jax.device_put(tokens, sharding),
+                                jax.device_put(targets, sharding))
+
+    for r in range(N_BF):
+        want_loss, g_ref = jax.value_and_grad(ref_loss)(
+            variables, tokens[r], targets[r])
+        np.testing.assert_allclose(float(np.asarray(loss_tp)[r]),
+                                   float(want_loss), rtol=1e-5)
+        flat_tp = jax.tree_util.tree_flatten_with_path(
+            jax.tree.map(lambda l: np.asarray(l)[r], g_tp))[0]
+        flat_ref = dict(jax.tree_util.tree_flatten_with_path(g_ref)[0])
+        for path, got in flat_tp:
+            want = np.asarray(flat_ref[path])
+            scale = max(np.abs(want).max(), 1e-6)
+            np.testing.assert_allclose(
+                got / scale, want / scale, atol=5e-5,
+                err_msg="/".join(str(getattr(k, "key", k)) for k in path))
+
+
+def test_vocab_parallel_checkpoint_decodes():
+    """The prescribed flow: train with vocab_parallel, serve through
+    the replicated head — llama_generate/init_cache must clear the
+    training-only layout knob (the param tree is identical)."""
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32, tp_axis="tp",
+                                  tp_size=2, vocab_parallel=True)
+    variables = models.Llama(
+        models.LlamaConfig.tiny(dtype=jnp.float32)).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    out = models.llama_generate(variables, cfg, prompt, 4)
+    assert out.shape == (1, 8)
+
+
+def test_vocab_parallel_pp_loss_matches(mesh):
+    """The pipeline loss builder composes with vocab_parallel: tp x pp
+    (2 x 2 on the 8-device mesh, dp=2) one-step loss equals the
+    unsharded CE on the same tokens."""
+    cfg1 = models.LlamaConfig.tiny(dtype=jnp.float32, scan_layers=True,
+                                   n_layers=4)
+    cfg2 = models.LlamaConfig.tiny(dtype=jnp.float32, scan_layers=True,
+                                   n_layers=4, tp_axis="tp", tp_size=2,
+                                   vocab_parallel=True)
+    mesh3 = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                 ("bf", "pp", "tp"))
+    m1 = models.Llama(cfg1)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 2, T), 0,
+                                cfg1.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (2, 2, T), 0,
+                                 cfg1.vocab_size)
+    variables = m1.init(jax.random.PRNGKey(1), tokens[0])
+    specs = llama_param_specs(variables, vocab_axis="tp",
+                              pp_axis="pp")
+    params = F.rank_major(variables, mesh3, specs=specs)
+    loss_fn = models.llama_pp_loss_fn(cfg2, pp_axis="pp", n_stages=2,
+                                      n_micro=2)
+
+    def shard(p, toks, tgt):
+        local = jax.tree.map(lambda l: l[0], p)
+        # only the last pp stage's CE survives the mask; psum over pp
+        # restores the full loss (the train step's reduction)
+        return jax.lax.psum(loss_fn(local, (toks[0], tgt[0])), "pp")[None]
+
+    sm = jax.shard_map(shard, mesh=mesh3,
+                       in_specs=(specs, P("bf"), P("bf")),
+                       out_specs=P("bf"), check_vma=False)
+    sharding = NamedSharding(mesh3, P("bf"))
+    loss = jax.jit(sm)(params, jax.device_put(tokens, sharding),
+                       jax.device_put(targets, sharding))
+
+    for r in range(2):
+        logits = m1.apply(variables, tokens[r])
+        want = float(jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets[r])))
+        np.testing.assert_allclose(float(np.asarray(loss)[r]), want,
+                                   rtol=1e-5)
