@@ -10,10 +10,20 @@ launcher covers the two launch shapes:
   each a ``jax.distributed`` member.  With ``--force-cpu-devices K`` each
   process simulates K CPU devices — the single-host stand-in for a pod,
   used by the multi-process test suite (SURVEY.md §4).
-* **Multi-host**: run the same ``bfrun`` command on every host with
-  ``--host-rank R --coordinator HOST0:PORT`` (or let the TPU platform's
-  launcher set the env) — no ssh orchestration needed, matching how TPU
-  pods actually start jobs.
+* **Multi-host, by hand**: run the same ``bfrun`` command on every host
+  with ``--host-rank R --coordinator HOST0:PORT`` (or let the TPU
+  platform's launcher set the env), matching how TPU pods start jobs.
+* **Multi-host, one command** (``-H host1:2,host2:2``): this ``bfrun``
+  ssh-checks every host, then spawns one remote ``bfrun`` per host over
+  ssh (cwd + whitelisted env propagated on the remote command line,
+  rank offsets from the slot list, coordinator defaulting to the first
+  host) and fail-fast tears the whole job down when any host's launcher
+  exits nonzero — the reference's one-command pod launch
+  (reference bluefog/run/run.py:121-203), re-based on ssh-fanout of the
+  local spawner instead of a vendored mpirun driver.
+  ``--launch-transport local`` swaps ssh for a local shell (host names
+  become labels) so the full orchestration path is testable — and
+  usable — without sshd.
 
 Child processes receive ``BLUEFOG_TPU_{COORDINATOR,NUM_PROCESSES,
 PROCESS_ID}``; ``bluefog_tpu.init()`` picks these up and calls
@@ -77,9 +87,45 @@ def make_parser() -> argparse.ArgumentParser:
                         "stalled ranks")
     parser.add_argument("--extra-env", action="append", default=[],
                         metavar="K=V", help="extra env for the children")
+    parser.add_argument("-H", "--hosts", default=None,
+                        metavar="host1:slots,host2:slots",
+                        help="one-command multi-host launch: spawn one "
+                             "remote bfrun per host over ssh with rank "
+                             "offsets from the slot list (total "
+                             "processes = sum of slots; -np may be "
+                             "omitted).  The coordinator defaults to "
+                             "the FIRST host")
+    parser.add_argument("--launch-transport", choices=("ssh", "local"),
+                        default="ssh",
+                        help="how -H reaches each host: 'ssh' (default) "
+                             "or 'local' (spawn every host's launcher "
+                             "on this machine — tests/sshd-less setups)")
+    parser.add_argument("--no-ssh-check", action="store_true",
+                        help="skip the pre-launch ssh reachability check")
+    parser.add_argument("--rank-offset", type=int, default=None,
+                        help=argparse.SUPPRESS)  # set by the -H parent:
+    # first global process id on this host (overrides host_rank *
+    # procs_per_host, which assumes uniform slots)
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="the program to run")
     return parser
+
+
+def parse_hosts(spec: str):
+    """``host1:2,host2:2`` -> ``[("host1", 2), ("host2", 2)]`` (the
+    reference's -H format, reference run_util.py hosts parsing)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        host, sep, slots = part.rpartition(":")
+        if not host or not sep or not slots.isdigit() or int(slots) < 1:
+            raise ValueError(
+                f"bad -H entry {part!r}: expected host:slots with "
+                "slots >= 1")
+        out.append((host, int(slots)))
+    if len({h for h, _ in out}) != len(out):
+        raise ValueError(f"duplicate host in -H list: {spec!r}")
+    return out
 
 
 def _coordinator_for_attempt(coordinator: str, attempt: int) -> str:
@@ -156,6 +202,29 @@ def _stream(proc: subprocess.Popen, rank: int, coordinator: str,
         sys.stdout.flush()
 
 
+def _supervise(children, describe, terminate_all) -> int:
+    """The shared fail-fast poll loop: wait for every child, and on the
+    FIRST nonzero exit report it (``describe(index, code)``) and tear
+    the rest down — the others may be blocked in collective rendezvous
+    waiting for the dead one forever.  Returns the first nonzero exit
+    code (or 0)."""
+    rc = 0
+    alive = list(children)
+    while alive:
+        for proc in list(alive):
+            code = proc.poll()
+            if code is None:
+                continue
+            alive.remove(proc)
+            if code != 0 and rc == 0:
+                rc = code
+                sys.stderr.write(describe(children.index(proc), code))
+                terminate_all()
+        if alive:
+            time.sleep(0.1)
+    return rc
+
+
 def _run_once(args, command, base_id: int, procs_per_host: int,
               attempt: int, port_bump: int = 0):
     """Returns ``(exit_code, bind_failed)``; exit_code is None for
@@ -189,24 +258,11 @@ def _run_once(args, command, base_id: int, procs_per_host: int,
                 daemon=True)
             t.start()
             threads.append(t)
-        # One failed rank must bring the job down (the others may be
-        # blocked in collective rendezvous waiting for it forever).
-        rc = 0
-        alive = list(children)
-        while alive:
-            for proc in list(alive):
-                code = proc.poll()
-                if code is None:
-                    continue
-                alive.remove(proc)
-                if code != 0:
-                    rc = rc or code
-                    sys.stderr.write(
-                        f"bfrun: rank {children.index(proc) + base_id} "
-                        f"exited with {code}; terminating the job\n")
-                    _terminate_all()
-            if alive:
-                time.sleep(0.1)
+        rc = _supervise(
+            children,
+            lambda i, code: (f"bfrun: rank {i + base_id} exited with "
+                             f"{code}; terminating the job\n"),
+            _terminate_all)
         for t in threads:
             t.join(timeout=5)
         return rc, bind_failed.is_set()
@@ -217,6 +273,161 @@ def _run_once(args, command, base_id: int, procs_per_host: int,
         # sentinel distinct from any child exit code (a child exiting
         # 130 must still be eligible for --restarts)
         return None, False
+    except Exception:
+        _terminate_all()
+        raise
+
+
+def _ssh_argv(host: str, tty: bool = False):
+    # BatchMode: fail fast instead of prompting for a password inside a
+    # launcher (the reference's ssh checks are likewise non-interactive).
+    # tty (-tt): launches run on a forced pty so the REMOTE side is
+    # SIGHUP'd when this client dies or is killed — without it, killing
+    # the local ssh process orphans every remote rank (non-pty sessions
+    # get no hangup; the remote bfrun's SIGHUP->teardown handler in
+    # main() would never fire).
+    argv = ["ssh", "-o", "BatchMode=yes", "-o", "ConnectTimeout=10"]
+    if tty:
+        argv.append("-tt")
+    return argv + [host]
+
+
+def check_ssh_reachability(hosts, timeout: float = 20.0):
+    """Probe every host with a no-op ssh command IN PARALLEL and raise
+    one error naming ALL unreachable hosts (reference run.py's
+    _check_all_hosts_ssh_successful behavior: fail before launching
+    anything anywhere)."""
+    procs = {h: subprocess.Popen(
+        _ssh_argv(h) + ["true"], stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE, text=True) for h, _ in hosts}
+    failed = []
+    deadline = time.time() + timeout
+    for host, proc in procs.items():
+        try:
+            rc = proc.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            failed.append(f"{host} (timeout)")
+            continue
+        if rc != 0:
+            err = (proc.stderr.read() or "").strip().splitlines()
+            failed.append(f"{host} ({err[-1] if err else f'rc {rc}'})")
+    if failed:
+        raise RuntimeError(
+            "bfrun: ssh unreachable: " + "; ".join(failed)
+            + ". Every host must accept passwordless ssh (BatchMode), "
+            "or use --launch-transport local / --no-ssh-check.")
+
+
+def _host_launcher_argv(args, host: str, host_rank: int, offset: int,
+                        slots: int, total: int, coordinator: str,
+                        command) -> list:
+    """The per-host process: a remote (or local) bfrun covering this
+    host's slot range.  cwd + the whitelisted env ride the command line
+    (`cd ... && env K=V ... python -m bluefog_tpu.run ...`), so the
+    remote side needs nothing but the repo at the same path."""
+    import shlex
+
+    inner = [sys.executable, "-m", "bluefog_tpu.run",
+             "-np", str(total), "--coordinator", coordinator,
+             "--host-rank", str(host_rank),
+             "--procs-per-host", str(slots),
+             "--rank-offset", str(offset)]
+    if args.force_cpu_devices:
+        inner += ["--force-cpu-devices", str(args.force_cpu_devices)]
+    if args.timeline_filename:
+        inner += ["--timeline-filename", args.timeline_filename]
+    for kv in args.extra_env:
+        inner += ["--extra-env", kv]
+    inner += ["--"] + list(command)
+    env_pairs = [f"{k}={v}" for k, v in sorted(os.environ.items())
+                 if k.startswith(PASS_PREFIXES)]
+    shell = ("cd " + shlex.quote(os.getcwd()) + " && exec env "
+             + " ".join(shlex.quote(p) for p in env_pairs) + " "
+             + " ".join(shlex.quote(t) for t in inner))
+    if args.launch_transport == "local":
+        return ["bash", "-c", shell]
+    return _ssh_argv(host, tty=True) + [shell]
+
+
+def _run_multihost(args, command) -> int:
+    try:
+        hosts = parse_hosts(args.hosts)
+    except ValueError as e:
+        sys.stderr.write(f"bfrun: {e}\n")
+        return 2
+    total = sum(s for _, s in hosts)
+    if args.num_proc not in (1, total):
+        sys.stderr.write(
+            f"bfrun: -np {args.num_proc} does not match the -H slot "
+            f"total {total} (omit -np with -H)\n")
+        return 2
+    if args.restarts:
+        sys.stderr.write(
+            "bfrun: --restarts only supports single-host launches "
+            "(multi-host elastic restart needs a cross-host "
+            "supervisor)\n")
+        return 2
+    coordinator = args.coordinator
+    if args.launch_transport == "ssh" and \
+            coordinator.startswith("127.0.0.1:"):
+        # the default loopback coordinator is meaningless across hosts:
+        # rendezvous on the first host — minus any ssh login name
+        # (-H user@host:2 is the common mpirun-style spec, but
+        # 'user@host' is not a resolvable rendezvous address)
+        first = hosts[0][0].rpartition("@")[2]
+        coordinator = first + ":" + coordinator.rpartition(":")[2]
+    if args.launch_transport == "ssh" and not args.no_ssh_check:
+        try:
+            check_ssh_reachability(hosts)
+        except RuntimeError as e:
+            sys.stderr.write(str(e) + "\n")
+            return 2
+
+    children, threads = [], []
+
+    def _terminate_all(sig=signal.SIGTERM):
+        for proc in children:
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(sig)
+                except OSError:
+                    pass
+
+    def _stream_host(proc, host):
+        for line in proc.stdout:
+            sys.stdout.write(f"[{host}] {line}")
+            sys.stdout.flush()
+
+    offset = 0
+    try:
+        for i, (host, slots) in enumerate(hosts):
+            argv = _host_launcher_argv(args, host, i, offset, slots,
+                                       total, coordinator, command)
+            proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT, text=True)
+            children.append(proc)
+            t = threading.Thread(target=_stream_host, args=(proc, host),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+            offset += slots
+        # a host's launcher exiting nonzero already tore down its own
+        # local ranks; take the other hosts with it
+        rc = _supervise(
+            children,
+            lambda i, code: (f"bfrun: host {hosts[i][0]} exited with "
+                             f"{code}; tearing down the remaining "
+                             "hosts\n"),
+            _terminate_all)
+        for t in threads:
+            t.join(timeout=5)
+        return rc
+    except KeyboardInterrupt:
+        _terminate_all(signal.SIGINT)
+        for proc in children:
+            proc.wait()
+        return 130
     except Exception:
         _terminate_all()
         raise
@@ -235,8 +446,25 @@ def main(argv=None) -> int:
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
+
+    # a dropped controlling connection (ssh teardown from a multi-host
+    # parent) or a TERM must take the local ranks down with us, exactly
+    # like Ctrl-C
+    def _teardown_signal(signum, frame):
+        raise KeyboardInterrupt
+
+    for _sig in (signal.SIGTERM, signal.SIGHUP):
+        try:
+            signal.signal(_sig, _teardown_signal)
+        except (ValueError, OSError):  # non-main thread / platform quirk
+            pass
+
+    if args.hosts:
+        return _run_multihost(args, command)
+
     procs_per_host = args.procs_per_host or args.num_proc
-    base_id = args.host_rank * procs_per_host
+    base_id = args.rank_offset if args.rank_offset is not None \
+        else args.host_rank * procs_per_host
     if base_id + procs_per_host > args.num_proc:
         sys.stderr.write("bfrun: host-rank/procs-per-host exceed -np\n")
         return 2
