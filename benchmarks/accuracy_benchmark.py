@@ -1,0 +1,203 @@
+"""End-to-end ACCURACY benchmark across the optimizer families.
+
+The reference's performance page leaves its accuracy section "TO BE
+ADDED" (reference docs/performance.rst:55-58) — this closes that row:
+train an MNIST-shaped CNN (and a CIFAR-shaped ResNet-18) to an accuracy
+target under each distributed-optimizer family, recording
+accuracy-vs-epoch, on an 8-rank virtual world (accuracy dynamics are
+hardware-independent; the SPMD program is the same one a pod runs).
+
+Families (all through the eager wrapper API, the reference-parity
+surface):
+  neighbor_allreduce (CTA, static exp2)     reference _DistributedReduceOptimizer
+  neighbor_allreduce dynamic one-peer (ATC) reference dynamic_topology_update idiom
+  gradient_allreduce (horovod-style)        reference _DistributedOptimizer
+  win_put (async gossip windows)            reference _DistributedWinPutOptimizer
+  push_sum (directed, bias-corrected)       reference _DistributedPushSumOptimizer
+
+Data is deterministic synthetic (zero-egress image: class templates +
+noise, the same generator as examples/mnist.py), held-out eval split,
+every rank evaluated — the artifact records mean and MIN over ranks, so
+a family that lets one rank drift cannot hide in the average.
+
+Run:  PYTHONPATH=. python benchmarks/accuracy_benchmark.py
+"""
+
+import json
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import models
+from bluefog_tpu.optim import (
+    CommunicationType,
+    DistributedAdaptThenCombineOptimizer,
+    DistributedAdaptWithCombineOptimizer,
+    DistributedGradientAllreduceOptimizer,
+    DistributedPushSumOptimizer,
+    DistributedWinPutOptimizer,
+)
+
+SIZE = 8
+MNIST_TARGET, CIFAR_TARGET = 0.95, 0.90
+
+
+def synthetic_images(samples, shape, classes=10, noise=0.3, seed=0):
+    """Class templates + iid noise (examples/mnist.py generator,
+    generalized to any HxWxC)."""
+    rng = np.random.RandomState(seed)
+    templates = (rng.rand(classes, *shape) > 0.7).astype(np.float32)
+    labels = rng.randint(0, classes, samples)
+    imgs = templates[labels] + noise * rng.randn(samples, *shape)
+    return imgs.astype(np.float32), labels.astype(np.int32)
+
+
+def make_family(name, base):
+    if name == "gradient_allreduce":
+        return DistributedGradientAllreduceOptimizer(base)
+    if name == "win_put":
+        return DistributedWinPutOptimizer(base)
+    if name == "push_sum":
+        return DistributedPushSumOptimizer(base)
+    if name == "neighbor_allreduce_dynamic":
+        return DistributedAdaptThenCombineOptimizer(
+            base, CommunicationType.neighbor_allreduce)
+    return DistributedAdaptWithCombineOptimizer(
+        base, CommunicationType.neighbor_allreduce)
+
+
+def dynamic_update(opt, i):
+    """Exp2 one-peer rotation (reference examples/pytorch_resnet.py
+    dynamic_topology_update): each round every rank averages with ONE
+    peer at distance 2^k."""
+    shift = 2 ** (i % int(np.log2(SIZE)))
+    opt.self_weight = 0.5
+    opt.src_weights = [{(r - shift) % SIZE: 0.5} for r in range(SIZE)]
+    opt.dst_weights = [{(r + shift) % SIZE: 0.5} for r in range(SIZE)]
+
+
+def run_config(family, model, train, test, *, epochs, batch_per_rank,
+               lr, has_bn=False):
+    bf.init()
+    n = bf.size()
+    assert n == SIZE
+    images, labels = train
+    loader = bf.DataLoader([images, labels],
+                           batch_size=n * batch_per_rank, world=n,
+                           rank_major=True, drop_last=True, seed=1)
+    sample = jnp.zeros((1,) + images.shape[1:])
+    base = model.init(jax.random.PRNGKey(42), sample)
+    replicate = lambda tree: jax.tree.map(
+        bf.rank_sharded,
+        jax.tree.map(lambda p: jnp.broadcast_to(p[None], (n,) + p.shape),
+                     tree))
+    params = replicate(base["params"])
+    aux = replicate(base["batch_stats"]) if has_bn else None
+
+    if has_bn:
+        def forward(p, a, x, y):
+            logits, upd = model.apply(
+                {"params": p, "batch_stats": a}, x, train=True,
+                mutable=["batch_stats"])
+            loss = jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(logits, y))
+            return loss, upd["batch_stats"]
+    else:
+        def forward(p, a, x, y):
+            logits = model.apply({"params": p}, x)
+            loss = jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(logits, y))
+            return loss, a
+
+    vgrad = jax.jit(jax.vmap(jax.value_and_grad(forward, has_aux=True),
+                             in_axes=(0, 0 if has_bn else None, 0, 0)))
+
+    @jax.jit
+    def evaluate(p, a, x, y):
+        def one(p, a):
+            var = {"params": p}
+            if has_bn:
+                var["batch_stats"] = a
+                logits = model.apply(var, x, train=False)
+            else:
+                logits = model.apply(var, x)
+            return jnp.mean(jnp.argmax(logits, -1) == y)
+        return jax.vmap(one, in_axes=(0, 0 if has_bn else None))(p, a)
+
+    opt = make_family(family, optax.sgd(lr, momentum=0.9))
+    state = opt.init(params)
+    tx, ty = jnp.asarray(test[0]), jnp.asarray(test[1])
+    curve = []
+    step = 0
+    for epoch in range(epochs):
+        for bx, by in loader:
+            if family == "neighbor_allreduce_dynamic":
+                dynamic_update(opt, step)
+            (loss, new_aux), grads = vgrad(
+                params, aux, bf.rank_sharded(bx), bf.rank_sharded(by))
+            if has_bn:
+                aux = new_aux
+            params, state = opt.step(params, grads, state)
+            step += 1
+        accs = np.asarray(evaluate(params, aux, tx, ty))
+        curve.append({"epoch": epoch, "acc_mean": round(float(accs.mean()), 4),
+                      "acc_min": round(float(accs.min()), 4),
+                      "loss": round(float(np.asarray(loss).mean()), 4)})
+        print(f"  {family} epoch {epoch}: acc {accs.mean():.3f} "
+              f"(min {accs.min():.3f})")
+    loader.close()
+    bf.shutdown()
+    return curve
+
+
+def main():
+    results = {"world": SIZE, "families": {}}
+
+    mnist_train = synthetic_images(SIZE * 256, (28, 28, 1), seed=0)
+    mnist_test = synthetic_images(512, (28, 28, 1), seed=99)
+    families = ["neighbor_allreduce_static", "neighbor_allreduce_dynamic",
+                "gradient_allreduce", "win_put", "push_sum"]
+    for fam in families:
+        print(f"MNIST / {fam}")
+        curve = run_config(fam, models.MnistNet(), mnist_train,
+                           mnist_test, epochs=5, batch_per_rank=32,
+                           lr=0.05)
+        reached = next((c["epoch"] for c in curve
+                        if c["acc_min"] >= MNIST_TARGET), None)
+        results["families"].setdefault(fam, {})["mnist"] = {
+            "target": MNIST_TARGET, "reached_epoch": reached,
+            "curve": curve}
+
+    cifar_train = synthetic_images(SIZE * 128, (32, 32, 3), seed=1)
+    cifar_test = synthetic_images(512, (32, 32, 3), seed=98)
+    for fam in ["neighbor_allreduce_static", "neighbor_allreduce_dynamic"]:
+        print(f"CIFAR-ResNet18 / {fam}")
+        curve = run_config(fam, models.ResNet18(num_classes=10),
+                           cifar_train, cifar_test, epochs=4,
+                           batch_per_rank=16, lr=0.02, has_bn=True)
+        reached = next((c["epoch"] for c in curve
+                        if c["acc_min"] >= CIFAR_TARGET), None)
+        results["families"][fam]["cifar_resnet18"] = {
+            "target": CIFAR_TARGET, "reached_epoch": reached,
+            "curve": curve}
+
+    results["note"] = (
+        "synthetic class-template data (zero-egress), held-out eval, "
+        "8-rank virtual world, eager wrapper API; acc_min is the WORST "
+        "rank. Reference accuracy section: 'TO BE ADDED' "
+        "(docs/performance.rst:55-58).")
+    with open("benchmarks/accuracy_r04.json", "w") as f:
+        json.dump(results, f, indent=1)
+    print("wrote benchmarks/accuracy_r04.json")
+
+
+if __name__ == "__main__":
+    main()

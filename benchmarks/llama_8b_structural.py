@@ -60,7 +60,8 @@ V5E_HBM_GB = 16.0
 B, T = 2, 4096  # per-dp-rank batch x sequence (microbatch 1 under pp)
 
 
-def cfg_8b(tp, vocab_parallel, pp, remat_policy="everything"):
+def cfg_8b(tp, vocab_parallel, pp, remat_policy="everything",
+           tp_seq_shard=False):
     # remat "everything" saves only layer boundaries (~134 MB per layer
     # at B=2/T=4096) and recomputes inside the backward; "dots" keeps
     # every matmul output (~0.7 GB per LAYER at 8B scale) and exists in
@@ -70,15 +71,15 @@ def cfg_8b(tp, vocab_parallel, pp, remat_policy="everything"):
         remat_policy=remat_policy, max_seq_len=8192,
         rope_scaling_kind="llama3",
         tp_axis="tp" if tp > 1 else None, tp_size=tp,
-        vocab_parallel=vocab_parallel)
+        vocab_parallel=vocab_parallel, tp_seq_shard=tp_seq_shard)
 
 
 def audit(name, dp, tp, pp, vocab_parallel=True,
-          remat_policy="everything", b=None):
+          remat_policy="everything", b=None, tp_seq_shard=False):
     n_chips = dp * tp * pp
     devices = jax.devices()[:n_chips]
     b = B if b is None else b
-    cfg = cfg_8b(tp, vocab_parallel, pp, remat_policy)
+    cfg = cfg_8b(tp, vocab_parallel, pp, remat_policy, tp_seq_shard)
     # abstract param tree from the tp-cleared twin (identical paths)
     plain = cfg_8b(1, False, pp, remat_policy)
     abstract = jax.eval_shape(lambda: models.Llama(plain).init(
@@ -141,6 +142,7 @@ def audit(name, dp, tp, pp, vocab_parallel=True,
     row = {
         "layout": name, "dp": dp, "tp": tp, "pp": pp,
         "vocab_parallel": bool(tp > 1 and vocab_parallel),
+        "tp_seq_shard": tp_seq_shard,
         "remat": remat_policy,
         "params_b": round(n_params / 1e9, 3),
         "batch_per_dp_rank": b, "seq": T,
@@ -212,6 +214,11 @@ def main():
     rows = [
         audit("tp8", 1, 8, 1),
         audit("tp8_b1", 1, 8, 1, b=1),
+        # Megatron sequence-parallel ACTIVATIONS: the residual stream,
+        # norms, and remat saves live [B, T/tp, D] per chip — the
+        # 8-chip group's missing ~2 GB (tp_seq_shard=True)
+        audit("tp8_seqshard", 1, 8, 1, tp_seq_shard=True),
+        audit("tp8_seqshard_b4", 1, 8, 1, b=4, tp_seq_shard=True),
         audit("tp4_pp2", 1, 4, 2),
         audit("tp2_pp4", 1, 2, 4),
         audit("dp2_tp2_pp2", 2, 2, 2),

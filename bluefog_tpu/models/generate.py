@@ -52,7 +52,8 @@ def _decode_cfg(cfg: LlamaConfig, max_len: int, keep_tp: bool = False,
         cfg, decode=True, max_seq_len=max_len, attn_mode="full",
         attn_impl="xla", sp_axis=None, ep_axis=None, ep_size=1,
         remat=False, remat_policy="none", kv_quant=kv_quant,
-        param_quant=weight_quant, vocab_parallel=False, **tp)
+        param_quant=weight_quant, vocab_parallel=False,
+        tp_seq_shard=False, **tp)
 
 
 def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int,

@@ -167,6 +167,17 @@ class LlamaConfig:
     # one pmax + two psums per step).  Training-only: decode keeps the
     # replicated head (no optimizer state there to dominate memory).
     vocab_parallel: bool = False
+    # Megatron sequence-parallel ACTIVATIONS (their "sequence
+    # parallelism" paper, distinct from ring/Ulysses attention SP): the
+    # residual stream, norms, and remat-saved layer boundaries live
+    # SEQ-SHARDED [B, T/tp, D] per chip; entering a tp region
+    # all-gathers the rows and leaving it reduce-scatters them (the
+    # conjugate pair _sp_region_in/_sp_region_out — same total bytes as
+    # the f/g identity/psum pair, but activation memory divides by tp).
+    # At 8B this is what lets an 8-CHIP tp group fit 16 GB v5e HBM
+    # (benchmarks/llama_8b_structural.py).  Training-only; composes
+    # with vocab_parallel (the head re-gathers rows once).
+    tp_seq_shard: bool = False
 
     def __post_init__(self):
         if self.decode and self.attn_mode != "full":
@@ -212,6 +223,35 @@ class LlamaConfig:
                     "(it shards the optimizer-state-bearing vocab "
                     "matrices); decode keeps the replicated head — drop "
                     "vocab_parallel from the decode config")
+        if self.tp_seq_shard:
+            if self.tp_size <= 1 or self.tp_axis is None:
+                raise ValueError("tp_seq_shard requires tensor "
+                                 "parallelism (tp_axis + tp_size > 1)")
+            if self.decode:
+                raise ValueError(
+                    "tp_seq_shard is a training-time activation layout; "
+                    "drop it from the decode config (llama_generate "
+                    "does this automatically)")
+            if self.n_experts:
+                raise ValueError(
+                    "tp_seq_shard + MoE is not supported (experts use "
+                    "the ep region operators; MoE configs exclude tp "
+                    "anyway)")
+            if self.attn_mode in ("ring", "ulysses"):
+                raise ValueError(
+                    "tp_seq_shard already shards the sequence over tp; "
+                    "composing it with ring/ulysses attention "
+                    "(sp_axis) is redundant — pick one")
+            if not self.vocab_parallel:
+                raise ValueError(
+                    "tp_seq_shard requires vocab_parallel=True: a "
+                    "REPLICATED logits head consumed by seq-sharded "
+                    "rows would get per-shard partial gradients (each "
+                    "shard only sees its own rows), while the "
+                    "vocab-parallel head re-gathers the rows once and "
+                    "stays exact — and at the scales where "
+                    "tp_seq_shard matters the vocab matrices dominate "
+                    "memory anyway")
         if self.rope_scaling_kind not in ("none", "llama3"):
             raise ValueError(
                 f"rope_scaling_kind {self.rope_scaling_kind!r} not in "
@@ -307,11 +347,20 @@ def _remat_policies():
 
 class RMSNorm(nn.Module):
     eps: float = 1e-5
+    # tp_seq_shard: the scale is a REPLICATED param consumed by
+    # seq-sharded rows, so its per-shard gradient is partial (each
+    # shard only sees its own rows); routing the param through the f
+    # operator (identity forward, psum backward) restores the full
+    # gradient on every shard — Megatron all-reduces its layernorm
+    # grads across the tp group for exactly this reason.
+    grad_psum_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
                            jnp.float32)
+        if self.grad_psum_axis is not None:
+            scale = _tp_region_in(scale, self.grad_psum_axis)
         x32 = x.astype(jnp.float32)
         normed = x32 * jax.lax.rsqrt(
             jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
@@ -399,6 +448,64 @@ def _tp_region_out_bwd(axis_name, _, g):
 
 
 _tp_region_out.defvjp(_tp_region_out_fwd, _tp_region_out_bwd)
+
+
+# Sequence-parallel-activation variants (cfg.tp_seq_shard): the residual
+# stream is SEQ-SHARDED [B, T/tp, D]; a tp region is entered by
+# all-gathering the rows and left by reduce-scattering the partial
+# outputs.  The pair is exactly conjugate (all_gather^T = reduce-scatter
+# and vice versa), so gradients equal the unsharded model's the same way
+# the f/g identity/psum pair's do (tests/test_tp_seq_shard.py).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _sp_region_in(x, axis_name):
+    return jax.lax.all_gather(x, axis_name, axis=1, tiled=True)
+
+
+def _sp_region_in_fwd(x, axis_name):
+    return _sp_region_in(x, axis_name), None
+
+
+def _sp_region_in_bwd(axis_name, _, g):
+    return (jax.lax.psum_scatter(g, axis_name, scatter_dimension=1,
+                                 tiled=True),)
+
+
+_sp_region_in.defvjp(_sp_region_in_fwd, _sp_region_in_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _sp_region_out(y, axis_name):
+    return jax.lax.psum_scatter(y, axis_name, scatter_dimension=1,
+                                tiled=True)
+
+
+def _sp_region_out_fwd(y, axis_name):
+    return _sp_region_out(y, axis_name), None
+
+
+def _sp_region_out_bwd(axis_name, _, g):
+    return (jax.lax.all_gather(g, axis_name, axis=1, tiled=True),)
+
+
+_sp_region_out.defvjp(_sp_region_out_fwd, _sp_region_out_bwd)
+
+
+def _enter_tp_region(x, cfg: LlamaConfig):
+    """Bring the (possibly seq-sharded) residual stream into a tp
+    parallel region: full rows out, conjugate backward."""
+    if cfg.tp_seq_shard:
+        return _sp_region_in(x, cfg.tp_axis)
+    return _tp_region_in(x, cfg.tp_axis)
+
+
+def _leave_tp_region(y, cfg: LlamaConfig):
+    """Merge the shards' partial outputs back onto the residual stream
+    layout (full psum, or summed seq shards under tp_seq_shard)."""
+    if cfg.tp_seq_shard:
+        return _sp_region_out(y, cfg.tp_axis)
+    return _tp_region_out(y, cfg.tp_axis)
+
+
 
 
 def _amax_quantize(x, eps: float = 1e-8):
@@ -505,7 +612,12 @@ class VocabParallelEmbed(nn.Module):
         x = jnp.take(table.astype(cfg.dtype),
                      jnp.clip(local, 0, v_local - 1), axis=0)
         x = jnp.where(valid[..., None], x, 0)
-        return _tp_region_out(x, cfg.tp_axis)
+        # under tp_seq_shard this reduce-scatters straight to the
+        # seq-sharded stream layout [B, T/tp, D] (half the wire bytes
+        # of a full psum followed by a slice; the backward all-gathers
+        # the disjoint row cotangents, so the table gradient still
+        # covers every row)
+        return _leave_tp_region(x, cfg)
 
 
 @functools.partial(jax.custom_jvp, nondiff_argnums=(1,))
@@ -557,15 +669,16 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x, pos_offset):
         cfg = self.cfg
-        b, t, _ = x.shape
         hd = cfg.head_dim
         dense = lambda feats, name: _dense(cfg, feats, name)
         # under TP this module runs per-shard: local head counts; wo's
-        # partial output is psum'd below (Megatron column->row pattern,
-        # entered through the 'f' operator so the backward is exact)
+        # partial output merges below (Megatron column->row pattern,
+        # entered through the 'f' operator — or the all-gather variant
+        # under tp_seq_shard — so the backward is exact)
         tp = cfg.tp_axis is not None and cfg.tp_size > 1
         if tp:
-            x = _tp_region_in(x, cfg.tp_axis)
+            x = _enter_tp_region(x, cfg)
+        b, t, _ = x.shape  # full rows (post-gather under tp_seq_shard)
         n_q = cfg.n_heads // cfg.tp_size
         n_kv = cfg.n_kv_heads // cfg.tp_size
         q = dense(n_q * hd, "wq")(x).reshape(b, t, n_q, hd)
@@ -608,7 +721,7 @@ class Attention(nn.Module):
         out = out.reshape(b, t, n_q * hd)
         proj = dense(cfg.dim, "wo")(out)
         if tp:
-            proj = _tp_region_out(proj, cfg.tp_axis)
+            proj = _leave_tp_region(proj, cfg)
         return proj
 
     def _decode_attend(self, q, k, v):
@@ -766,13 +879,13 @@ class FeedForward(nn.Module):
         dense = lambda feats, name: _dense(cfg, feats, name)
         tp = cfg.tp_axis is not None and cfg.tp_size > 1
         if tp:
-            x = _tp_region_in(x, cfg.tp_axis)
+            x = _enter_tp_region(x, cfg)
         local_ffn = cfg.ffn_dim // cfg.tp_size
         gate = dense(local_ffn, "w1")(x)
         up = dense(local_ffn, "w3")(x)
         down = dense(cfg.dim, "w2")(nn.silu(gate) * up)
         if tp:
-            down = _tp_region_out(down, cfg.tp_axis)
+            down = _leave_tp_region(down, cfg)
         return down
 
 
@@ -959,12 +1072,16 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, pos_offset):
-        x = x + Attention(self.cfg, name="attention")(
-            RMSNorm(self.cfg.norm_eps, name="attention_norm")(x), pos_offset)
-        ffn_cls = MoEFeedForward if self.cfg.n_experts else FeedForward
-        name = "moe_ffn" if self.cfg.n_experts else "feed_forward"
-        x = x + ffn_cls(self.cfg, name=name)(
-            RMSNorm(self.cfg.norm_eps, name="ffn_norm")(x))
+        cfg = self.cfg
+        naxis = cfg.tp_axis if cfg.tp_seq_shard else None
+        x = x + Attention(cfg, name="attention")(
+            RMSNorm(cfg.norm_eps, grad_psum_axis=naxis,
+                    name="attention_norm")(x), pos_offset)
+        ffn_cls = MoEFeedForward if cfg.n_experts else FeedForward
+        name = "moe_ffn" if cfg.n_experts else "feed_forward"
+        x = x + ffn_cls(cfg, name=name)(
+            RMSNorm(cfg.norm_eps, grad_psum_axis=naxis,
+                    name="ffn_norm")(x))
         return x
 
 
@@ -990,7 +1107,14 @@ class Llama(nn.Module):
         assert tokens.shape[1] <= cfg.max_seq_len, (
             f"sequence shard {tokens.shape[1]} exceeds max_seq_len "
             f"{cfg.max_seq_len}")
+        if cfg.tp_seq_shard:
+            assert tokens.shape[1] % cfg.tp_size == 0, (
+                f"sequence length {tokens.shape[1]} must divide by "
+                f"tp_size ({cfg.tp_size}) under tp_seq_shard")
         if cfg.vocab_parallel:
+            # with tp_seq_shard the embed reduce-scatters straight to
+            # this shard's rows [B, T/tp, D] — the layout the whole
+            # residual stream lives in between tp regions
             x = VocabParallelEmbed(cfg, name="tok_embeddings")(tokens)
         else:
             x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
@@ -1024,7 +1148,9 @@ class Llama(nn.Module):
                                           policy=policy)
             for i in range(cfg.n_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(x, pos_offset)
-        x = RMSNorm(cfg.norm_eps, name="norm")(x)
+        x = RMSNorm(cfg.norm_eps,
+                    grad_psum_axis=cfg.tp_axis if cfg.tp_seq_shard
+                    else None, name="norm")(x)
         if cfg.decode:
             # generation only ever samples from the final position — skip
             # the other T-1 head matmuls and the [B, T, vocab] logits
@@ -1043,12 +1169,15 @@ class Llama(nn.Module):
             # logits columns [B, T, vocab/tp] — NOT psum-merged (the
             # full matrix would be the memory the layout exists to
             # avoid); train against vocab_parallel_xent.  x enters the
-            # parallel region through f so the backward psum is exact.
+            # parallel region through f so the backward psum is exact
+            # (under tp_seq_shard the entry re-gathers the rows ONCE,
+            # since each shard's vocab columns are needed for EVERY
+            # row's softmax).
             head_dtype = jnp.float32 if cfg.logits_dot_in_fp32 else cfg.dtype
             logits = nn.Dense(cfg.vocab_size // cfg.tp_size,
                               use_bias=False, dtype=head_dtype,
                               param_dtype=jnp.float32, name="output")(
-                                  _tp_region_in(x, cfg.tp_axis))
+                                  _enter_tp_region(x, cfg))
         else:
             head_dtype = jnp.float32 if cfg.logits_dot_in_fp32 else cfg.dtype
             logits = nn.Dense(cfg.vocab_size, use_bias=False,
@@ -1119,6 +1248,12 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
     if cfg.n_layers % (n_stages * n_loops):
         raise ValueError(f"n_layers ({cfg.n_layers}) must divide by "
                          f"n_stages*n_loops ({n_stages}*{n_loops})")
+    if cfg.tp_seq_shard:
+        raise ValueError(
+            "tp_seq_shard is not supported in the pipeline loss builder "
+            "yet (the stage boundary would have to carry seq-sharded "
+            "activations through the pp permute); use it with the plain "
+            "stack, or pp without tp_seq_shard")
 
     from bluefog_tpu.parallel.pipeline import gpipe, gpipe_circular
 

@@ -1,0 +1,39 @@
+"""The generated API reference stays buildable and non-trivial.
+
+The reference ships a Sphinx autodoc tree (reference docs/*.rst, 16
+files); ours is the introspection generator docs/gen_api_reference.py.
+This test regenerates it into a temp dir — so a rename that breaks a
+documented module fails CI, the way a sphinx build would.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_reference_generates(tmp_path):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("XLA_", "JAX_"))}
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BLUEFOG_API_REF_OUT"] = str(tmp_path)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "docs",
+                                      "gen_api_reference.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    pages = list(tmp_path.glob("*.md"))
+    assert len(pages) >= 20, [p.name for p in pages]
+    index = (tmp_path / "index.md").read_text()
+    # the core surfaces are present and documented
+    for mod in ("bluefog_tpu.api", "bluefog_tpu.topology",
+                "bluefog_tpu.optim", "bluefog_tpu.models",
+                "bluefog_tpu.interop.tf_adapter"):
+        assert mod in index, index
+    api = (tmp_path / "bluefog_tpu_api.md").read_text()
+    for op in ("neighbor_allreduce", "win_put", "allgather"):
+        assert op in api, op
+    total = sum(len(p.read_text().splitlines()) for p in pages)
+    assert total > 1500, total  # non-trivial: real docstrings, not stubs
