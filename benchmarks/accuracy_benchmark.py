@@ -48,13 +48,22 @@ from bluefog_tpu.optim import (
 
 SIZE = 8
 MNIST_TARGET, CIFAR_TARGET = 0.95, 0.90
+FAMILIES = ("neighbor_allreduce_static", "neighbor_allreduce_dynamic",
+            "gradient_allreduce", "win_put", "push_sum")
+# bump when the generator/hyperparameters change: chunked runs refuse
+# to merge into an artifact written by incomparable code
+CONFIG_VERSION = "r04.1-template-seed-1234-mnist5ep-cifar3ep"
 
 
-def synthetic_images(samples, shape, classes=10, noise=0.3, seed=0):
+def synthetic_images(samples, shape, classes=10, noise=0.3, seed=0,
+                     template_seed=1234):
     """Class templates + iid noise (examples/mnist.py generator,
-    generalized to any HxWxC)."""
+    generalized to any HxWxC).  The TEMPLATES come from their own seed
+    so train and held-out eval share the same underlying classes while
+    drawing disjoint noise/labels (``seed``)."""
+    rng_t = np.random.RandomState(template_seed)
+    templates = (rng_t.rand(classes, *shape) > 0.7).astype(np.float32)
     rng = np.random.RandomState(seed)
-    templates = (rng.rand(classes, *shape) > 0.7).astype(np.float32)
     labels = rng.randint(0, classes, samples)
     imgs = templates[labels] + noise * rng.randn(samples, *shape)
     return imgs.astype(np.float32), labels.astype(np.int32)
@@ -81,7 +90,9 @@ def dynamic_update(opt, i):
     shift = 2 ** (i % int(np.log2(SIZE)))
     opt.self_weight = 0.5
     opt.src_weights = [{(r - shift) % SIZE: 0.5} for r in range(SIZE)]
-    opt.dst_weights = [{(r + shift) % SIZE: 0.5} for r in range(SIZE)]
+    # list form: destinations only — a dict would SCALE the sent payload
+    # on top of the receiver's 0.5 combine weight and leak mass
+    opt.dst_weights = [[(r + shift) % SIZE] for r in range(SIZE)]
 
 
 def run_config(family, model, train, test, *, epochs, batch_per_rank,
@@ -158,13 +169,47 @@ def run_config(family, model, train, test, *, epochs, batch_per_rank,
     return curve
 
 
+OUT = "benchmarks/accuracy_r04.json"
+
+
+def _load():
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            prev = json.load(f)
+        if prev.get("config_version") == CONFIG_VERSION:
+            return prev
+        print(f"discarding {OUT}: config_version "
+              f"{prev.get('config_version')!r} != {CONFIG_VERSION!r} "
+              "(results would not be comparable)")
+    return {"world": SIZE, "config_version": CONFIG_VERSION,
+            "families": {}}
+
+
+def _save(results):
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=1)
+
+
 def main():
-    results = {"world": SIZE, "families": {}}
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--families", default=None,
+                    help="comma list; default all (results MERGE into "
+                    "the artifact, so chunked runs compose)")
+    ap.add_argument("--skip-cifar", action="store_true")
+    fargs = ap.parse_args()
+    results = _load()
 
     mnist_train = synthetic_images(SIZE * 256, (28, 28, 1), seed=0)
     mnist_test = synthetic_images(512, (28, 28, 1), seed=99)
-    families = ["neighbor_allreduce_static", "neighbor_allreduce_dynamic",
-                "gradient_allreduce", "win_put", "push_sum"]
+    families = list(FAMILIES)
+    if fargs.families:
+        families = [f.strip() for f in fargs.families.split(",")]
+        unknown = [f for f in families if f not in FAMILIES]
+        if unknown:
+            ap.error(f"unknown families {unknown}; choose from "
+                     f"{list(FAMILIES)}")
     for fam in families:
         print(f"MNIST / {fam}")
         curve = run_config(fam, models.MnistNet(), mnist_train,
@@ -175,28 +220,32 @@ def main():
         results["families"].setdefault(fam, {})["mnist"] = {
             "target": MNIST_TARGET, "reached_epoch": reached,
             "curve": curve}
+        _save(results)
 
     cifar_train = synthetic_images(SIZE * 128, (32, 32, 3), seed=1)
     cifar_test = synthetic_images(512, (32, 32, 3), seed=98)
-    for fam in ["neighbor_allreduce_static", "neighbor_allreduce_dynamic"]:
+    cifar_fams = [] if fargs.skip_cifar else [
+        f for f in ("neighbor_allreduce_static",
+                    "neighbor_allreduce_dynamic") if f in families]
+    for fam in cifar_fams:
         print(f"CIFAR-ResNet18 / {fam}")
         curve = run_config(fam, models.ResNet18(num_classes=10),
-                           cifar_train, cifar_test, epochs=4,
+                           cifar_train, cifar_test, epochs=3,
                            batch_per_rank=16, lr=0.02, has_bn=True)
         reached = next((c["epoch"] for c in curve
                         if c["acc_min"] >= CIFAR_TARGET), None)
         results["families"][fam]["cifar_resnet18"] = {
             "target": CIFAR_TARGET, "reached_epoch": reached,
             "curve": curve}
+        _save(results)
 
     results["note"] = (
         "synthetic class-template data (zero-egress), held-out eval, "
         "8-rank virtual world, eager wrapper API; acc_min is the WORST "
         "rank. Reference accuracy section: 'TO BE ADDED' "
         "(docs/performance.rst:55-58).")
-    with open("benchmarks/accuracy_r04.json", "w") as f:
-        json.dump(results, f, indent=1)
-    print("wrote benchmarks/accuracy_r04.json")
+    _save(results)
+    print(f"wrote {OUT}")
 
 
 if __name__ == "__main__":
