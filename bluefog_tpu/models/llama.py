@@ -144,7 +144,7 @@ class LlamaConfig:
     # Inference-time quantization (decode is HBM-bound: every step
     # streams all params + the K/V cache once, so bytes ARE time).
     # kv_quant="int8": the decode K/V caches store int8 with one f32
-    # scale per (batch, position, kv_head) vector; both scales commute
+    # scale per (batch, kv_head, position) vector; both scales commute
     # out of the attention contractions (over head_dim for scores, over
     # positions via the probabilities for values), so dequantization
     # fuses into the matmul operand reads and HBM traffic halves.
@@ -743,32 +743,38 @@ class Attention(nn.Module):
         q = rotary_embed(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = rotary_embed(k, positions, cfg.rope_theta, cfg.rope_scaling)
         zero = jnp.zeros((), idx.dtype)
+        # caches live KV-HEAD-MAJOR [B, KV, S, D] — the batch-dim layout
+        # the attention dot_generals want, so no step pays a transpose
+        # of the whole cache (measured: the [B, S, KV, D] layout cost
+        # two cache-sized transposes per layer per decode step)
+        k = jnp.swapaxes(k, 1, 2)  # [B, KV, T, D] (tiny: T=1 in decode)
+        v = jnp.swapaxes(v, 1, 2)
         if cfg.kv_quant == "int8":
-            # int8 cache, one f32 scale per (batch, position, kv_head)
+            # int8 cache, one f32 scale per (batch, kv_head, position)
             # vector.  Both scales commute out of the contractions (the
             # key scale is constant over head_dim, the value scale folds
             # into the probabilities), so the dequant below fuses into
             # the attention matmul reads — HBM streams int8.
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (b, max_len, n_kv, hd), jnp.int8)
+                               (b, n_kv, max_len, hd), jnp.int8)
             cks = self.variable("cache", "cached_key_scale", jnp.zeros,
-                                (b, max_len, n_kv), jnp.float32)
+                                (b, n_kv, max_len), jnp.float32)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (b, max_len, n_kv, hd), jnp.int8)
+                               (b, n_kv, max_len, hd), jnp.int8)
             cvs = self.variable("cache", "cached_value_scale", jnp.zeros,
-                                (b, max_len, n_kv), jnp.float32)
+                                (b, n_kv, max_len), jnp.float32)
 
             kq, ks = _amax_quantize(k)
             vq, vs = _amax_quantize(v)
-            ks, vs = ks[..., 0], vs[..., 0]  # scale per (b, t, kv_head)
+            ks, vs = ks[..., 0], vs[..., 0]  # scale per (b, kv_head, t)
             kq_all = lax.dynamic_update_slice(ck.value, kq,
-                                              (zero, idx, zero, zero))
+                                              (zero, zero, idx, zero))
             ks_all = lax.dynamic_update_slice(cks.value, ks,
-                                              (zero, idx, zero))
+                                              (zero, zero, idx))
             vq_all = lax.dynamic_update_slice(cv.value, vq,
-                                              (zero, idx, zero, zero))
+                                              (zero, zero, idx, zero))
             vs_all = lax.dynamic_update_slice(cvs.value, vs,
-                                              (zero, idx, zero))
+                                              (zero, zero, idx))
             ck.value, cks.value = kq_all, ks_all
             cv.value, cvs.value = vq_all, vs_all
             ci.value = idx + t
@@ -783,13 +789,13 @@ class Attention(nn.Module):
             v_all = vq_all.astype(jnp.float32) * vs_all[..., None]
         else:
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (b, max_len, n_kv, hd), cfg.dtype)
+                               (b, n_kv, max_len, hd), cfg.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (b, max_len, n_kv, hd), cfg.dtype)
+                               (b, n_kv, max_len, hd), cfg.dtype)
             k_all = lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (zero, idx, zero, zero))
+                ck.value, k.astype(cfg.dtype), (zero, zero, idx, zero))
             v_all = lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (zero, idx, zero, zero))
+                cv.value, v.astype(cfg.dtype), (zero, zero, idx, zero))
             ck.value, cv.value, ci.value = k_all, v_all, idx + t
         # queries live at global positions [idx, idx+t); the causal mask
         # there also excludes the cache's unwritten (zero) tail
@@ -810,13 +816,15 @@ def _cached_attention(q, k_all, v_all, idx):
     (the int8 cache path) fuses into the dot operand reads.
 
     q: [B, T, n_q, D] (global positions ``idx + arange(T)``),
-    k_all/v_all: [B, S, n_kv, D].  Returns [B, T, n_q, D] in q's dtype.
+    k_all/v_all: KV-HEAD-MAJOR [B, n_kv, S, D] (the cache layout — the
+    dots' batch dims lead, so no per-step transpose of the cache).
+    Returns [B, T, n_q, D] in q's dtype.
     """
     b, t, n_q, d = q.shape
-    s, n_kv = k_all.shape[1], k_all.shape[2]
+    n_kv, s = k_all.shape[1], k_all.shape[2]
     rep = n_q // n_kv
     q5 = q.reshape(b, t, n_kv, rep, d).astype(jnp.float32)
-    scores = jnp.einsum("btkrd,bskd->bkrts", q5,
+    scores = jnp.einsum("btkrd,bksd->bkrts", q5,
                         k_all.astype(jnp.float32)) * (1.0 / d ** 0.5)
     q_pos = idx + jnp.arange(t)
     mask = jnp.arange(s)[None, :] <= q_pos[:, None]  # [T, S]
@@ -824,7 +832,7 @@ def _cached_attention(q, k_all, v_all, idx):
     # every query row sees at least its own key (just written), so no
     # fully-masked-row guard is needed
     p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkrts,bskd->btkrd", p, v_all.astype(jnp.float32))
+    out = jnp.einsum("bkrts,bksd->btkrd", p, v_all.astype(jnp.float32))
     return out.reshape(b, t, n_q, d).astype(q.dtype)
 
 
@@ -841,30 +849,32 @@ def _cached_attention_int8(q, kq_all, ks_all, vq_all, vs_all, idx):
     QuantDense plays on activations).  Rounding beyond the cache's own
     int8 snap: the queries' and probabilities' per-row int8 quant.
 
-    q: [B, T, n_q, D] (positions ``idx + arange(T)``),
-    kq_all/vq_all: int8 [B, S, n_kv, D], ks_all/vs_all: f32 [B, S, n_kv].
+    q: [B, T, n_q, D] (positions ``idx + arange(T)``), kq_all/vq_all:
+    int8 KV-HEAD-MAJOR [B, n_kv, S, D], ks_all/vs_all: f32
+    [B, n_kv, S] (the cache layout — batch dims lead the dots, no
+    per-step cache transpose).
     """
     b, t, n_q, d = q.shape
-    s, n_kv = kq_all.shape[1], kq_all.shape[2]
+    n_kv, s = kq_all.shape[1], kq_all.shape[2]
     rep = n_q // n_kv
     qq, qs = _amax_quantize(q.reshape(b, t, n_kv, rep, d))
-    s32 = jnp.einsum("btkrd,bskd->bkrts", qq, kq_all,
+    s32 = jnp.einsum("btkrd,bksd->bkrts", qq, kq_all,
                      preferred_element_type=jnp.int32)
     # scales: q per row [B,T,KV,R,1] -> [B,KV,R,T,1]; k per position
-    # [B,S,KV] -> [B,KV,1,1,S]
+    # [B,KV,S] broadcasts directly
     scores = (s32.astype(jnp.float32)
               * jnp.transpose(qs, (0, 2, 3, 1, 4))
-              * jnp.transpose(ks_all, (0, 2, 1))[:, :, None, None, :]
+              * ks_all[:, :, None, None, :]
               * (1.0 / d ** 0.5))
     q_pos = idx + jnp.arange(t)
     mask = jnp.arange(s)[None, :] <= q_pos[:, None]  # [T, S]
     scores = jnp.where(mask[None, None, None], scores, -1e30)
     p = jax.nn.softmax(scores, axis=-1)  # [B,KV,R,T,S]
-    pv = p * jnp.transpose(vs_all, (0, 2, 1))[:, :, None, None, :]
+    pv = p * vs_all[:, :, None, None, :]
     # eps far below any realistic row amax: a probability row sums to 1,
     # so amax >= 1/S — the tiny eps only guards fully-padded rows
     pq, ps = _amax_quantize(pv, eps=1e-30)
-    o32 = jnp.einsum("bkrts,bskd->btkrd", pq, vq_all,
+    o32 = jnp.einsum("bkrts,bksd->btkrd", pq, vq_all,
                      preferred_element_type=jnp.int32)
     out = o32.astype(jnp.float32) * jnp.transpose(ps, (0, 3, 1, 2, 4))
     return out.reshape(b, t, n_q, d).astype(q.dtype)
