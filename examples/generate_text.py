@@ -27,6 +27,13 @@ parser.add_argument("--prompt", default="The quick brown fox")
 parser.add_argument("--max-new-tokens", type=int, default=32)
 parser.add_argument("--temperature", type=float, default=0.0)
 parser.add_argument("--seed", type=int, default=0)
+parser.add_argument("--kv-quant", default="none", choices=["none", "int8"],
+                    help="int8 K/V cache (half the cache HBM traffic)")
+parser.add_argument("--weight-quant", default="none",
+                    choices=["none", "int8", "w8a8"],
+                    help="int8 weights (weight-only, or w8a8 with native "
+                    "s8 MXU dots); params are quantized once up front — "
+                    "see docs/performance.md for which mode wins where")
 
 
 def main():
@@ -52,8 +59,12 @@ def main():
             np.random.RandomState(args.seed).randint(0, cfg.vocab_size,
                                                      (1, 8)), jnp.int32)
 
+    if args.weight_quant != "none":
+        variables = jax.jit(models.quantize_llama_params)(variables)
     out = llama_generate(variables, cfg, prompt, args.max_new_tokens,
-                         temperature=args.temperature, rng=rng)
+                         temperature=args.temperature, rng=rng,
+                         kv_quant=args.kv_quant,
+                         weight_quant=args.weight_quant)
     out = np.asarray(out)
     if args.hf:
         print(tok.decode(out[0], skip_special_tokens=True))
