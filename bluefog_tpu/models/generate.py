@@ -35,13 +35,29 @@ def _decode_cfg(cfg: LlamaConfig, max_len: int, keep_tp: bool = False,
     training-time layouts); tensor parallelism is KEPT when requested —
     a tp-sharded K/V-cached decode serves checkpoints too big for one
     chip (each shard holds its own heads' cache; outputs merge through
-    the same f/g psum pair as training)."""
+    the same f/g psum pair as training).
+
+    MoE configs decode with DROPLESS routing (capacity_factor raised to
+    n_experts, so per-group capacity >= group_tokens * top_k): train-
+    time capacity drops depend on which tokens are co-batched, so a
+    cached one-token-at-a-time decode could never reproduce them —
+    dropless routing removes the coupling entirely (each token always
+    gets its full top-k combine, making the output grouping-invariant),
+    and the cached decode matches the dropless full forward
+    token-for-token (tests/test_moe_decode.py).  This is the standard
+    inference treatment: capacity is a training-throughput knob, not
+    part of the learned function.  The training config's
+    ``moe_group_size`` is KEPT: grouped dropless routing is exact too,
+    and it is what keeps the prefill's dispatch/combine tensors linear
+    in the prompt length."""
+    moe = {}
     if cfg.n_experts:
-        raise NotImplementedError(
-            "llama_generate does not support MoE configs yet: expert "
-            "capacity drops depend on how many tokens route together, so "
-            "a cached decode (one token at a time) would not reproduce "
-            "the full-forward logits token-for-token")
+        if cfg.moe_router != "topk":
+            raise NotImplementedError(
+                "llama_generate supports only moe_router='topk' "
+                "(expert_choice is non-causal and cannot decode)")
+        moe = dict(capacity_factor=max(cfg.capacity_factor,
+                                       float(cfg.n_experts)))
     tp = {} if keep_tp else {"tp_axis": None, "tp_size": 1}
     # vocab_parallel is a training-time memory layout (it shards the
     # optimizer-state-bearing vocab matrices); decode clears it like the
@@ -53,7 +69,7 @@ def _decode_cfg(cfg: LlamaConfig, max_len: int, keep_tp: bool = False,
         attn_impl="xla", sp_axis=None, ep_axis=None, ep_size=1,
         remat=False, remat_policy="none", kv_quant=kv_quant,
         param_quant=weight_quant, vocab_parallel=False,
-        tp_seq_shard=False, **tp)
+        tp_seq_shard=False, **moe, **tp)
 
 
 def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int,

@@ -186,12 +186,30 @@ class LlamaConfig:
                 f"{self.attn_mode!r}); incremental K/V caching and "
                 "ring/blockwise attention do not compose")
         if self.decode and self.n_experts:
-            raise ValueError(
-                "decode=True does not support MoE: routing groups/"
-                "capacities depend on how many tokens are processed "
-                "together, so a cached decode cannot reproduce the "
-                "full-forward logits token-for-token (see "
-                "models/generate.py)")
+            # capacity-dropped routing depends on how many tokens are
+            # processed together, so a cached decode (one token at a
+            # time) could not reproduce a capacity-dropped forward
+            # token-for-token.  DROPLESS routing removes the coupling:
+            # with per-group capacity >= group_tokens * top_k
+            # (capacity_factor >= n_experts — exact for ANY group
+            # size), every token gets its full top-k combine no matter
+            # what it is co-batched with, so the cached decode matches
+            # the dropless full forward exactly
+            # (tests/test_moe_decode.py).  llama_generate raises the
+            # capacity automatically; grouping stays as configured (it
+            # keeps prefill dispatch memory linear in prompt length).
+            if self.moe_router != "topk":
+                raise ValueError(
+                    "decode=True supports only moe_router='topk' "
+                    "(expert_choice is non-causal)")
+            if self.capacity_factor < self.n_experts:
+                raise ValueError(
+                    "decode=True with MoE requires DROPLESS routing: "
+                    "capacity_factor >= n_experts (per-group capacity "
+                    ">= group_tokens * top_k), so the cached "
+                    "one-token-at-a-time decode reproduces the "
+                    "dropless forward exactly — llama_generate "
+                    "configures this automatically")
         if self.kv_quant not in ("none", "int8"):
             raise ValueError(
                 f"kv_quant {self.kv_quant!r} not in ('none', 'int8')")

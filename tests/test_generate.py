@@ -77,8 +77,12 @@ def test_generate_validates_inputs():
     with pytest.raises(ValueError, match="rng"):
         llama_generate(variables, cfg, jnp.asarray(prompt), NEW,
                        temperature=0.7)
-    moe = models.LlamaConfig.tiny(dtype=jnp.float32, n_experts=4)
-    with pytest.raises(NotImplementedError, match="MoE"):
+    # MoE decode is supported (dropless routing — tests/test_moe_decode);
+    # only the non-causal expert_choice router still refuses
+    moe = models.LlamaConfig.tiny(dtype=jnp.float32, n_experts=4,
+                                  moe_router="expert_choice",
+                                  allow_noncausal_router=True)
+    with pytest.raises(NotImplementedError, match="expert_choice"):
         llama_generate(variables, moe, jnp.asarray(prompt), NEW)
     with pytest.raises(ValueError, match="max_new_tokens"):
         llama_generate(variables, cfg, jnp.asarray(prompt), 0)
