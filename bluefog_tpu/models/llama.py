@@ -587,7 +587,11 @@ class QuantDense(nn.Module):
         y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
         if self.out_f32:
             return y.astype(jnp.float32) * scale
-        return y * scale.astype(self.dtype)
+        # scale in f32 then cast the product: keeps the module's
+        # 'x @ (W_q * s) == (x @ W_q) * s exactly' contract — casting
+        # the scale itself to bf16 first would add ~0.4% scale-rounding
+        # error on top of the int8 snap.
+        return (y.astype(jnp.float32) * scale).astype(self.dtype)
 
 
 def _dense(cfg: LlamaConfig, feats: int, name: str):
@@ -874,6 +878,14 @@ def _cached_attention_int8(q, kq_all, ks_all, vq_all, vs_all, idx):
     """
     b, t, n_q, d = q.shape
     n_kv, s = kq_all.shape[1], kq_all.shape[2]
+    # the value contraction accumulates s8 x s8 into int32 with
+    # worst-case magnitude 127*127*S, which crosses INT32_MAX near
+    # S ~ 133k — refuse silently-overflowing cache lengths (chunk the
+    # position contraction if longer contexts are ever needed)
+    if s > 131072:
+        raise ValueError(
+            f"kv_quant='int8' + w8a8 decode supports cache length <= "
+            f"131072 (int32 accumulator overflow at ~133k); got {s}")
     rep = n_q // n_kv
     qq, qs = _amax_quantize(q.reshape(b, t, n_kv, rep, d))
     s32 = jnp.einsum("btkrd,bksd->bkrts", qq, kq_all,

@@ -202,13 +202,19 @@ def _stream(proc: subprocess.Popen, rank: int, coordinator: str,
         sys.stdout.flush()
 
 
+_TERMINATE_GRACE_S = 5.0
+
+
 def _supervise(children, describe, terminate_all) -> int:
     """The shared fail-fast poll loop: wait for every child, and on the
     FIRST nonzero exit report it (``describe(index, code)``) and tear
     the rest down — the others may be blocked in collective rendezvous
-    waiting for the dead one forever.  Returns the first nonzero exit
-    code (or 0)."""
+    waiting for the dead one forever.  A child that ignores SIGTERM
+    (e.g. an ssh client hung on a dead connection in the ``-H`` path)
+    is SIGKILLed after a grace period so teardown cannot block
+    indefinitely.  Returns the first nonzero exit code (or 0)."""
     rc = 0
+    term_deadline = None
     alive = list(children)
     while alive:
         for proc in list(alive):
@@ -220,7 +226,12 @@ def _supervise(children, describe, terminate_all) -> int:
                 rc = code
                 sys.stderr.write(describe(children.index(proc), code))
                 terminate_all()
+                term_deadline = time.monotonic() + _TERMINATE_GRACE_S
         if alive:
+            if term_deadline is not None \
+                    and time.monotonic() > term_deadline:
+                terminate_all(signal.SIGKILL)
+                term_deadline = float("inf")  # escalate once
             time.sleep(0.1)
     return rc
 

@@ -68,19 +68,26 @@ def make_config():
         **extra)
 
 
-def stream_bytes_per_step(variables, cfg) -> int:
+def stream_bytes_per_step(variables, cfg, batch_size) -> int:
     """HBM bytes one decode step reads for parameters: every leaf in its
     STREAM dtype — int8 kernels 1 B/el, f32 QuantDense scales 4 B/el,
     full-precision params the casted compute-dtype copy XLA streams
     (2 B/el at bf16), except the logits head which streams f32 when
     ``logits_dot_in_fp32`` (the dot itself runs in f32 — there is no
-    casted copy to stream)."""
+    casted copy to stream).  The token-embedding table is NOT streamed
+    whole: decode gathers ``batch_size`` rows per step, so only those
+    rows count (the table is ~16% of params at 200M — charging it fully
+    would understate the ceiling and inflate utilization)."""
     compute_bytes = 2 if cfg.dtype == jnp.bfloat16 else 4
     total = 0
     for path, leaf in jax.tree_util.tree_leaves_with_path(
             variables["params"]):
         names = [str(getattr(p, "key", p)) for p in path]
-        if leaf.dtype == jnp.int8:
+        if "tok_embeddings" in names:
+            # gather of B rows, in the leaf's storage dtype
+            row_bytes = leaf.size // leaf.shape[0] * leaf.dtype.itemsize
+            total += batch_size * row_bytes
+        elif leaf.dtype == jnp.int8:
             total += leaf.size
         elif names[-1] == "scale" and names[-2] in QUANT_KERNELS:
             total += leaf.size * 4
@@ -131,7 +138,7 @@ def main():
 
     # decode-step HBM floor: params once, in their stream dtype, plus
     # the written K/V cache (mean over the decode phase)
-    param_bytes = stream_bytes_per_step(variables, cfg)
+    param_bytes = stream_bytes_per_step(variables, cfg, args.batch_size)
     kv_vec = cfg.head_dim * (1 if args.kv_quant == "int8" else
                              (2 if args.dtype == "bf16" else 4)) \
         + (4 if args.kv_quant == "int8" else 0)  # + the f32 scale
