@@ -32,6 +32,12 @@ Schedules projected
   (NOT the 1-D ``min(2^k, n-2^k)`` = 18.1 closed-form guess: shifts of
   16*2^j are single/double row hops, and L/2 column shifts split over
   both ring directions).  One 7-round period reaches the EXACT average.
+* ``dynamic_torus_exp2`` — ``topology.torus_one_peer_schedule`` exp2 mode
+  (round 5): per-axis exponential-2 shifts IN TORUS COORDINATES.  Exact
+  average each 7-round period (like ``dynamic``) at machine-counted
+  congestion with no row-major boundary spill — the schedule
+  ``topology.default_pod_schedule`` selects for pod shapes, and the
+  documented default.
 * ``dynamic_torus_1hop`` — ``topology.torus_one_peer_schedule`` single-hop
   mode: every round is a one-ICI-hop torus rotation, congestion exactly
   1 by construction (pessimistic == optimistic), at the cost of slower
@@ -67,6 +73,7 @@ from bluefog_tpu.topology import (  # noqa: E402
     ExponentialTwoGraph,
     TorusSpec,
     consensus_contraction,
+    default_pod_schedule,
     one_peer_dynamic_schedule,
     rounds_to_consensus,
     schedule_congestion,
@@ -75,7 +82,9 @@ from bluefog_tpu.topology import (  # noqa: E402
 )
 
 BATCH = 128
-MODES = ("dynamic", "dynamic_torus_1hop", "neighbor_allreduce", "horovod")
+MODES = ("dynamic", "dynamic_torus_exp2", "dynamic_torus_1hop",
+         "neighbor_allreduce", "horovod")
+DYNAMIC_MODES = ("dynamic", "dynamic_torus_exp2", "dynamic_torus_1hop")
 
 
 def torus_shape(n):
@@ -89,6 +98,8 @@ def torus_shape(n):
 def make_schedule(mode, n):
     if mode == "dynamic":
         return one_peer_dynamic_schedule(n)
+    if mode == "dynamic_torus_exp2":
+        return torus_one_peer_schedule(torus_shape(n), "exp2")
     if mode == "dynamic_torus_1hop":
         return torus_one_peer_schedule(torus_shape(n), "single_hop")
     return None
@@ -106,7 +117,7 @@ def build_step(n, mode, compress=None):
         return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
             logits, y)), updates["batch_stats"]
 
-    if mode in ("dynamic", "dynamic_torus_1hop"):
+    if mode in DYNAMIC_MODES:
         kwargs = dict(schedule=make_schedule(mode, n), comm_mode="atc")
     elif mode == "neighbor_allreduce":
         kwargs = dict(topology=uniform_topology_spec(ExponentialTwoGraph(n)),
@@ -195,7 +206,7 @@ def mixing_table(n, pbytes, link_gbps, wire_scales):
     bw = link_gbps * 1e9 / 8
     spec = TorusSpec(torus_shape(n))
     out = {}
-    for mode in ("dynamic", "dynamic_torus_1hop"):
+    for mode in DYNAMIC_MODES:
         sched = make_schedule(mode, n)
         cong = schedule_congestion(sched, spec)
         sigma = consensus_contraction(sched)
@@ -209,6 +220,8 @@ def mixing_table(n, pbytes, link_gbps, wire_scales):
             "exact_average_per_period": bool(sigma < 1e-12),
             "rounds_to_1e-3_consensus": round(r2c, 1),
             "comm_ms_to_1e-3_consensus_f32": round(r2c * ms_per_round, 2),
+            "comm_ms_to_1e-3_consensus_bf16": round(
+                r2c * ms_per_round * wire_scales["bf16"], 2),
             "comm_ms_to_1e-3_consensus_int8": round(
                 r2c * ms_per_round * wire_scales["int8"], 2),
         }
@@ -224,7 +237,7 @@ def main():
     ap.add_argument("--sizes", default="8,16,32",
                     help="mesh sizes to compile and extract HLO from")
     ap.add_argument("--project-sizes", default="16,64,128")
-    ap.add_argument("--out", default="benchmarks/scaling_projection_r04.json")
+    ap.add_argument("--out", default="benchmarks/scaling_projection_r05.json")
     args = ap.parse_args()
 
     compile_sizes = [int(s) for s in args.sizes.split(",")]
@@ -256,11 +269,14 @@ def main():
                and not r["compress"])
     tor = next(r for r in extracted
                if r["mode"] == "dynamic_torus_1hop" and r["n"] == nbig)
+    texp = next(r for r in extracted
+                if r["mode"] == "dynamic_torus_exp2" and r["n"] == nbig)
     stat = next(r for r in extracted
                 if r["mode"] == "neighbor_allreduce" and r["n"] == nbig)
     hvd = next(r for r in extracted
                if r["mode"] == "horovod" and r["n"] == nbig)
     tor_sched = make_schedule("dynamic_torus_1hop", nbig)
+    texp_sched = make_schedule("dynamic_torus_exp2", nbig)
     tor_spec = TorusSpec(torus_shape(nbig))
     checks = {
         # one parameter-size transmit per step (README.rst:51-60 claim)
@@ -285,6 +301,18 @@ def main():
         # ...so its machine-routed congestion is exactly 1
         "torus_1hop_congestion_is_1":
         schedule_congestion(tor_sched, tor_spec)["max"] == 1.0,
+        # torus-exp2: one parameter-size transmit per step...
+        "torus_exp2_bytes_eq_params":
+        abs(texp["per_step_bytes"] / pbytes - 1) < 0.05,
+        # ...EXACT average each period (hypercube dissemination per axis)
+        "torus_exp2_exact_average_per_period":
+        consensus_contraction(texp_sched) < 1e-12,
+        # ...at machine-counted mean congestion far below the 1-D
+        # min(2^k, n - 2^k) closed-form worst case (~18.1 at n=128)
+        "torus_exp2_congestion_below_1d_bound":
+        schedule_congestion(texp_sched, tor_spec)["mean"]
+        < np.mean([min(2 ** k, nbig - 2 ** k)
+                   for k in range(int(np.log2(nbig)))]),
     }
     checks = {k: bool(v) for k, v in checks.items()}  # np.bool_ -> json
     for name, ok in checks.items():
@@ -311,7 +339,7 @@ def main():
             per_mode[mode + "_full_rate"] = project(
                 pbytes, mode, n, args.step_ms, args.ici_gbps,
                 congestion=full_rate)
-            if mode in ("dynamic", "dynamic_torus_1hop"):
+            if mode in DYNAMIC_MODES:
                 for c, scale in wire_scales.items():
                     per_mode[f"{mode}_{c}_wire"] = project(
                         pbytes * scale, mode, n, args.step_ms,
@@ -356,6 +384,15 @@ def main():
         "analytic_cross_checks": checks,
         "projected_efficiency": projections,
         "mixing": mix,
+        "default_pod_schedule": {
+            "torus": list(torus_shape(max(project_sizes))),
+            "report": default_pod_schedule(
+                torus_shape(max(project_sizes)))[1],
+            "note": "topology.default_pod_schedule picks the schedule "
+                    "by machine-counted cost-to-consensus (mean "
+                    "congestion x rounds to 1e-3), tie-broken by "
+                    "per-step congestion — exp2 wins on pod tori",
+        },
         "north_star": {
             "target": ">=95% scaling efficiency at v5e-128 (BASELINE.md)",
             "model": "hop-accounted (pessimistic); the round-3 optimistic "
@@ -365,6 +402,11 @@ def main():
             projections[big]["dynamic"]["efficiency_no_overlap"],
             f"one_peer_dynamic_int8_at_{big}":
             projections[big]["dynamic_int8_wire"]["efficiency_no_overlap"],
+            f"torus_exp2_at_{big}":
+            projections[big]["dynamic_torus_exp2"]["efficiency_no_overlap"],
+            f"torus_exp2_int8_at_{big}":
+            projections[big]["dynamic_torus_exp2_int8_wire"]
+            ["efficiency_no_overlap"],
             f"torus_1hop_at_{big}":
             projections[big]["dynamic_torus_1hop"]["efficiency_no_overlap"],
             f"torus_1hop_int8_at_{big}":
@@ -372,11 +414,14 @@ def main():
             ["efficiency_no_overlap"],
             f"ring_allreduce_at_{big}":
             projections[big]["horovod"]["efficiency_no_overlap"],
-            "note": "dynamic (exp2) reaches the EXACT average each "
-                    "7-round period (mixing table); torus_1hop trades "
-                    "mixing speed for congestion-1 rounds — both beat "
-                    "ring allreduce, and both clear 95% with the shipped "
-                    "int8 wire compressor under the pessimistic model",
+            "note": "torus_exp2 (round 5, the default_pod_schedule pick) "
+                    "reaches the EXACT average each 7-round period AND "
+                    "routes on physical axes with no row-major boundary "
+                    "spill; torus_1hop trades mixing speed for "
+                    "congestion-1 rounds (~712 rounds to 1e-3, mixing "
+                    "table) — all dynamic families beat ring allreduce "
+                    "and clear 95% with the shipped int8 wire compressor "
+                    "under the pessimistic model",
         },
     }
     with open(args.out, "w") as fh:

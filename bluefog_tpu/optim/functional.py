@@ -193,11 +193,25 @@ def push_sum_weights(mesh: Mesh, axis_name: str = "bf") -> jax.Array:
 def _combine_fn(spec: CommSpec, axis_name: str,
                 hierarchical_local_size: Optional[int],
                 compress: Optional[str] = None) -> Callable:
+    """Combine branch ``fn(tree, key)``; ``key`` feeds the stochastic
+    wire rounder under ``compress='int8_sr'`` and is ignored (then DCE'd
+    by XLA) everywhere else."""
     if hierarchical_local_size is not None:
-        return lambda tree: jax.tree.map(
+        return lambda tree, key: jax.tree.map(
             lambda p: C.hierarchical_neighbor_allreduce(
                 p, spec, hierarchical_local_size, axis_name), tree)
-    return lambda tree: jax.tree.map(
+    if compress == "int8_sr":
+        def fn(tree, key):
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            outs = [
+                C.neighbor_allreduce(
+                    p, spec, axis_name, compress="int8",
+                    wire_key=jax.random.fold_in(key, i))
+                for i, p in enumerate(leaves)
+            ]
+            return jax.tree_util.tree_unflatten(treedef, outs)
+        return fn
+    return lambda tree, key: jax.tree.map(
         lambda p: C.neighbor_allreduce(p, spec, axis_name,
                                        compress=compress), tree)
 
@@ -254,6 +268,12 @@ def build_train_step(
     ``compress="int8"`` quantizes the cta/atc combine's wire payload
     (per-tensor absmax int8; see ``collectives.neighbor_allreduce``) —
     4x less ICI/DCN traffic at ~0.4% relative error per exchange.
+    ``compress="int8_sr"`` is the same wire format with UNBIASED
+    stochastic rounding (per-step, per-rank, per-leaf PRNG folding):
+    round-to-nearest's deterministic snaps can accumulate into a
+    consensus error floor in iterated averaging at pod rank counts,
+    stochastic rounding's zero-mean noise averages out instead — the
+    n=128 floor comparison is benchmarks/wire_quant_consensus.py.
     ``compress="bf16"`` rounds the wire payload to bfloat16 (2x less
     traffic for f32 params, self term stays full precision).
 
@@ -278,7 +298,7 @@ def build_train_step(
             "pipeline-sharded leaves (layer stacks, NOT reduced over pp) "
             "apart from pp-replicated ones (embeddings/head, psum'd)")
     if compress is not None:
-        if compress not in ("int8", "bf16"):
+        if compress not in ("int8", "int8_sr", "bf16"):
             raise ValueError(f"unknown compress mode {compress!r}")
         if comm_mode not in ("cta", "atc") or hierarchical_local_size:
             raise ValueError(
@@ -304,9 +324,13 @@ def build_train_step(
             return params
 
         def run(params):
+            # per-step key for the stochastic wire rounder (int8_sr);
+            # unused operands are dead-code-eliminated otherwise
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(0x51EED), step)
             if len(branches) == 1:
-                return branches[0](params)
-            return lax.switch(step % len(branches), branches, params)
+                return branches[0](params, key)
+            return lax.switch(step % len(branches), branches, params, key)
 
         if k_comm > 1:
             # lax.cond actually skips the collectives on off-cycle steps

@@ -175,13 +175,29 @@ def _permute_bf16_wire(x: jax.Array, axis_name: str, perm) -> jax.Array:
     return r
 
 
-def _wire_quantize_int8(x: jax.Array):
+def _wire_quantize_int8(x: jax.Array, key: Optional[jax.Array] = None):
     """Per-tensor absmax int8 quantization for the ppermute payload:
-    4x (f32) / 2x (bf16) fewer bytes on the ICI/DCN wire."""
+    4x (f32) / 2x (bf16) fewer bytes on the ICI/DCN wire.
+
+    ``key=None`` rounds to nearest — deterministic but BIASED: in an
+    iterated averaging process every round pushes each entry the same
+    direction, so the per-round snaps can accumulate into a consensus
+    error floor that grows with rank count.  With a PRNG ``key`` the
+    fractional part rounds STOCHASTICALLY (floor(y + u), u ~ U[0,1)):
+    E[q] == y exactly, so quantization noise enters the mixing recursion
+    zero-mean and averages out instead of compounding — the n=128
+    simulation in benchmarks/wire_quant_consensus.py measures the two
+    floors side by side."""
     x32 = x.astype(jnp.float32)
     scale = jnp.max(jnp.abs(x32)) / 127.0
     safe = jnp.where(scale == 0.0, 1.0, scale)
-    q = jnp.clip(jnp.round(x32 / safe), -127, 127).astype(jnp.int8)
+    y = x32 / safe
+    if key is None:
+        q = jnp.round(y)
+    else:
+        u = jax.random.uniform(key, x32.shape, jnp.float32)
+        q = jnp.floor(y + u)
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
     return q, scale
 
 
@@ -192,6 +208,7 @@ def neighbor_allreduce(
     compress: Optional[str] = None,
     class_weights: Optional[jax.Array] = None,
     self_weights: Optional[jax.Array] = None,
+    wire_key: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Weighted neighbor averaging — THE BlueFog primitive.
 
@@ -215,11 +232,23 @@ def neighbor_allreduce(
     OPERANDS; ``spec`` then only contributes the edge structure, so one
     compiled program serves every weight schedule over that structure
     (eager retrace-hazard fix — same design as windows.py's put/update).
+
+    ``wire_key`` (int8 only) switches the wire quantizer to UNBIASED
+    stochastic rounding: pass a PRNG key (vary it per step, e.g.
+    ``jax.random.fold_in(base, step)``); it is folded with the rank
+    index so every rank draws independent rounding noise.  See
+    ``_wire_quantize_int8`` for why round-to-nearest can build a
+    consensus floor in iterated averaging.
     """
     if compress not in (None, "int8", "bf16"):
         raise ValueError(f"unknown compress mode {compress!r}")
+    if wire_key is not None and compress != "int8":
+        raise ValueError("wire_key= requires compress='int8'")
     acc_dtype = _accum_dtype(x.dtype)
     idx = lax.axis_index(axis_name)
+    if wire_key is not None:
+        # independent rounding noise per rank
+        wire_key = jax.random.fold_in(wire_key, idx)
     if self_weights is None:
         self_w = jnp.asarray(_self_weights_of(spec), dtype=acc_dtype)[idx]
     else:
@@ -256,7 +285,7 @@ def neighbor_allreduce(
                 w_fused = (class_weights.astype(acc_dtype)
                            * jnp.asarray(masks, acc_dtype)).sum(0)[idx]
             if compress == "int8":
-                q, scale = _wire_quantize_int8(x)
+                q, scale = _wire_quantize_int8(x, wire_key)
                 rcv = (lax.ppermute(q, axis_name, merged)
                        .astype(jnp.float32)
                        * lax.ppermute(scale, axis_name, merged))
@@ -269,7 +298,7 @@ def neighbor_allreduce(
 
     received, weights = [], [self_w]
     if compress == "int8":
-        q, scale = _wire_quantize_int8(x)
+        q, scale = _wire_quantize_int8(x, wire_key)
         for c, cls in enumerate(spec.shift_classes):
             rq = lax.ppermute(q, axis_name, cls.perm)
             rs = lax.ppermute(scale, axis_name, cls.perm)

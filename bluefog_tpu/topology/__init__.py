@@ -49,4 +49,6 @@ from bluefog_tpu.topology.torus import (  # noqa: F401
     schedule_congestion,
     consensus_contraction,
     rounds_to_consensus,
+    score_schedule,
+    default_pod_schedule,
 )

@@ -174,3 +174,65 @@ def test_train_step_with_torus_schedule():
         params, opt_state, _ = step_fn(params, opt_state, batch,
                                        jnp.int32(i))
     assert float(F.consensus_distance(params)) < 1e-6
+
+
+def test_score_schedule_figures():
+    """score_schedule reports the per-step wire multiplier (mean
+    congestion) and the congestion-weighted rounds to consensus —
+    hand-checkable on the (8, 16) pod torus: exp2 = exact average in 7
+    rounds at mean congestion 16/7, so cost_to_consensus == 16."""
+    from bluefog_tpu.topology import score_schedule
+
+    spec = TorusSpec((8, 16))
+    exp2 = score_schedule(torus_one_peer_schedule((8, 16), "exp2"), spec)
+    assert exp2["rounds_per_period"] == 7
+    assert exp2["exact_average_per_period"] == 1.0
+    np.testing.assert_allclose(exp2["mean_congestion"], 16 / 7, rtol=1e-12)
+    np.testing.assert_allclose(exp2["cost_to_consensus"], 16.0, rtol=1e-12)
+    hop = score_schedule(
+        torus_one_peer_schedule((8, 16), "single_hop"), spec)
+    assert hop["mean_congestion"] == 1.0
+    assert hop["cost_to_consensus"] > 40 * exp2["cost_to_consensus"]
+
+
+def test_default_pod_schedule_picks_exp2_on_pod_tori():
+    """On power-of-two tori the machine-counted score selects the torus
+    exp2 schedule (exact average, ~45x cheaper to consensus than
+    single-hop), and the returned schedule is the winner itself."""
+    from bluefog_tpu.topology import default_pod_schedule
+
+    for axes in ((4, 4), (8, 16)):
+        sched, report = default_pod_schedule(axes)
+        assert report["exp2"]["selected"] == 1.0
+        assert report["single_hop"]["selected"] == 0.0
+        assert consensus_contraction(sched) < 1e-12  # it IS the exp2 one
+        assert len(sched) == sum(
+            int(np.log2(L)) for L in axes if L > 1)
+    with pytest.raises(ValueError):
+        default_pod_schedule((1, 1))
+
+
+def test_default_pod_schedule_drives_train_step():
+    """The selected default schedule plugs straight into build_train_step
+    and reaches the exact average each period on the (2, 4) virtual
+    torus."""
+    from bluefog_tpu.topology import default_pod_schedule
+
+    mesh = Mesh(np.array(jax.devices()[:N]), ("bf",))
+    schedule, _ = default_pod_schedule((2, 4))
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch @ params["x"]) ** 2)
+
+    step_fn = F.build_train_step(
+        loss_fn, optax.sgd(0.0), mesh, comm_mode="cta", schedule=schedule)
+    params = {"x": jax.device_put(
+        np.arange(N * 4, dtype=np.float64).reshape(N, 4),
+        NamedSharding(mesh, P("bf")))}
+    opt_state = F.rank_major(optax.sgd(0.0).init({"x": jnp.zeros(4)}), mesh)
+    batch = jax.device_put(np.ones((N, 2, 4)), NamedSharding(mesh, P("bf")))
+    for i in range(len(schedule)):
+        params, opt_state, _ = step_fn(params, opt_state, batch,
+                                       jnp.int32(i))
+    # pure averaging (lr 0): one period -> exact consensus
+    assert float(F.consensus_distance(params)) < 1e-6
