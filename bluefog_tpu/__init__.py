@@ -111,6 +111,9 @@ from bluefog_tpu.topology import (  # noqa: F401
     # reference exposes these on the main module (torch/__init__.py:109)
     InferDestinationFromSourceRanks,
     InferSourceFromDestinationRanks,
+    # the documented default one-peer schedule for pod torus shapes,
+    # picked by machine-counted congestion + mixing score (torus.py)
+    default_pod_schedule,
 )
 from bluefog_tpu import optim  # noqa: F401
 from bluefog_tpu import data  # noqa: F401
@@ -118,4 +121,6 @@ from bluefog_tpu.data import (  # noqa: F401
     DataLoader,
     DistributedSampler,
     device_prefetch,
+    load_mnist,
+    load_cifar10,
 )

@@ -23,13 +23,18 @@ TPU-shaped:
 
 from __future__ import annotations
 
+import gzip
+import os
+import pickle
 import queue
+import struct
 import threading
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["DistributedSampler", "DataLoader", "device_prefetch"]
+__all__ = ["DistributedSampler", "DataLoader", "device_prefetch",
+           "load_mnist", "load_cifar10"]
 
 
 class DistributedSampler:
@@ -87,6 +92,100 @@ class DistributedSampler:
 
     def __len__(self):
         return self.num_samples
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Read one IDX-format file (the MNIST wire format), gzipped or raw.
+
+    IDX header: 2 zero bytes, a type code (0x08 = uint8), the number of
+    dimensions, then that many big-endian uint32 dim sizes, then the raw
+    data.  Only uint8 payloads are supported (all of MNIST is)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as fh:
+        zero, dtype_code, ndim = struct.unpack(">HBB", fh.read(4))
+        if zero != 0 or dtype_code != 0x08:
+            raise ValueError(
+                f"{path}: not a uint8 IDX file "
+                f"(header {zero:#06x} {dtype_code:#04x})")
+        dims = struct.unpack(">" + "I" * ndim, fh.read(4 * ndim))
+        data = np.frombuffer(fh.read(), dtype=np.uint8)
+    if data.size != int(np.prod(dims)):
+        raise ValueError(
+            f"{path}: payload {data.size} != header dims {dims}")
+    return data.reshape(dims)
+
+
+def _find_file(roots: Sequence[str], names: Sequence[str]) -> str:
+    for root in roots:
+        for name in names:
+            p = os.path.join(root, name)
+            if os.path.exists(p):
+                return p
+    raise FileNotFoundError(
+        f"none of {list(names)} under {list(roots)}")
+
+
+def load_mnist(root: str, split: str = "train"
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Load an on-disk MNIST in the standard IDX layout (the format the
+    reference's examples consume via torchvision,
+    reference examples/pytorch_mnist.py:37-49 — zero egress: this only
+    READS a directory that already exists).
+
+    Accepts ``root`` pointing at the files directly or at a torchvision-
+    style tree (``root/MNIST/raw``); files may be gzipped
+    (``train-images-idx3-ubyte.gz``) or raw.
+
+    Returns ``(images [N, 28, 28, 1] float32 in [0, 1], labels [N]
+    int32)`` — the shapes the shipped MLP/examples already train on.
+    """
+    if split not in ("train", "test"):
+        raise ValueError(f"split must be 'train' or 'test', got {split!r}")
+    prefix = "train" if split == "train" else "t10k"
+    roots = [root, os.path.join(root, "MNIST", "raw"),
+             os.path.join(root, "raw")]
+    images = _read_idx(_find_file(roots, [
+        f"{prefix}-images-idx3-ubyte.gz", f"{prefix}-images-idx3-ubyte",
+        f"{prefix}-images.idx3-ubyte"]))
+    labels = _read_idx(_find_file(roots, [
+        f"{prefix}-labels-idx1-ubyte.gz", f"{prefix}-labels-idx1-ubyte",
+        f"{prefix}-labels.idx1-ubyte"]))
+    if images.ndim != 3 or labels.ndim != 1 \
+            or images.shape[0] != labels.shape[0]:
+        raise ValueError(
+            f"MNIST shape mismatch: {images.shape} vs {labels.shape}")
+    return (images.astype(np.float32)[..., None] / 255.0,
+            labels.astype(np.int32))
+
+
+def load_cifar10(root: str, split: str = "train"
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Load an on-disk CIFAR-10 in the standard python-pickle layout
+    (``cifar-10-batches-py``: ``data_batch_1..5`` + ``test_batch``, each
+    a pickle with ``data [10000, 3072]`` uint8 channel-major rows and
+    ``labels``).  ``root`` may point at the batch directory or its
+    parent.
+
+    Returns ``(images [N, 32, 32, 3] float32 in [0, 1], labels [N]
+    int32)``.
+    """
+    if split not in ("train", "test"):
+        raise ValueError(f"split must be 'train' or 'test', got {split!r}")
+    roots = [root, os.path.join(root, "cifar-10-batches-py")]
+    names = ([f"data_batch_{i}" for i in range(1, 6)]
+             if split == "train" else ["test_batch"])
+    imgs, labels = [], []
+    for name in names:
+        with open(_find_file(roots, [name]), "rb") as fh:
+            d = pickle.load(fh, encoding="bytes")
+        data = np.asarray(d[b"data"], dtype=np.uint8)
+        if data.ndim != 2 or data.shape[1] != 3072:
+            raise ValueError(
+                f"{name}: expected [N, 3072] uint8, got {data.shape}")
+        imgs.append(data.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        labels.append(np.asarray(d[b"labels"], dtype=np.int32))
+    return (np.concatenate(imgs).astype(np.float32) / 255.0,
+            np.concatenate(labels))
 
 
 class _PythonPipeline:

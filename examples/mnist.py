@@ -43,6 +43,11 @@ parser.add_argument("--epochs", type=int, default=3)
 parser.add_argument("--batch-size", type=int, default=32)
 parser.add_argument("--lr", type=float, default=0.05)
 parser.add_argument("--samples-per-rank", type=int, default=256)
+parser.add_argument("--data-dir", default=None,
+                    help="directory holding a real on-disk MNIST in the "
+                    "standard IDX layout (gz or raw, torchvision tree "
+                    "accepted — bf.load_mnist); default: deterministic "
+                    "synthetic data (zero-egress environment)")
 args = parser.parse_args()
 
 
@@ -83,7 +88,12 @@ def main():
         bf.set_machine_topology(ExponentialGraph(bf.machine_size()))
     n = bf.size()
     model = models.MnistNet()
-    images, labels = synthetic_mnist(n * args.samples_per_rank)
+    if args.data_dir:
+        images, labels = bf.load_mnist(args.data_dir, split="train")
+        images = images[:n * args.samples_per_rank]
+        labels = labels[:n * args.samples_per_rank]
+    else:
+        images, labels = synthetic_mnist(n * args.samples_per_rank)
     loader = bf.DataLoader([images, labels],
                            batch_size=n * args.batch_size, world=n,
                            rank_major=True, drop_last=True, seed=1)
