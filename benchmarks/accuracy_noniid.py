@@ -55,7 +55,6 @@ import optax  # noqa: E402
 
 import bluefog_tpu as bf  # noqa: E402
 from bluefog_tpu import models  # noqa: E402
-from bluefog_tpu.optim import functional as F  # noqa: E402
 from benchmarks.accuracy_benchmark import (  # noqa: E402
     FAMILIES, dynamic_update, make_family, synthetic_images)
 
@@ -67,14 +66,16 @@ CLASSES = 10
 # would let `--noise 0.3` merge into a noise-1.2 artifact silently)
 CONFIG_SCHEME = "r05.1-noniid"
 ALPHAS = ("0.1", "1", "inf")
-OUT = "benchmarks/accuracy_r05.json"
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "accuracy_r05.json")
 
 
 def config_version(fargs) -> str:
     data = os.path.abspath(fargs.data_dir) if fargs.data_dir else (
         f"synthetic-noise{fargs.noise}")
     return (f"{CONFIG_SCHEME}-{data}-{fargs.samples_per_rank}pr-"
-            f"{fargs.epochs}ep-b{fargs.batch_per_rank}-lr{fargs.lr}")
+            f"{fargs.epochs}ep-b{fargs.batch_per_rank}-lr{fargs.lr}-"
+            f"s{fargs.seeds}")
 
 
 def dirichlet_partition(labels, alpha, rng, n_ranks=SIZE):
@@ -119,6 +120,21 @@ def batches(images, labels, pools, batch_per_rank, rng):
         yield images[sl], labels[sl]
 
 
+def consensus_sq(params):
+    """Host-side mean squared deviation from the rank mean (the
+    optim.functional.consensus_distance formula computed in numpy: the
+    jitted version adds an AllReduce program that races the in-flight
+    step psums in XLA:CPU's in-process communicator and can abort the
+    rendezvous — on a real pod use the jitted one inside the step)."""
+    total, count = 0.0, 0
+    for leaf in jax.tree.leaves(params):
+        a = np.asarray(leaf)
+        m = a.mean(axis=0, keepdims=True)
+        total += float(((a - m) ** 2).sum())
+        count += a.size
+    return total / count
+
+
 def run_family(family, train, test, pools, *, epochs, batch_per_rank, lr,
                seed=0):
     bf.init()
@@ -127,7 +143,7 @@ def run_family(family, train, test, pools, *, epochs, batch_per_rank, lr,
     images, labels = train
     model = models.MnistNet()
     sample = jnp.zeros((1,) + images.shape[1:])
-    base = model.init(jax.random.PRNGKey(42), sample)
+    base = model.init(jax.random.PRNGKey(42 + seed), sample)
     params = jax.tree.map(
         lambda p: bf.rank_sharded(
             jnp.broadcast_to(p[None], (n,) + p.shape)), base["params"])
@@ -158,8 +174,9 @@ def run_family(family, train, test, pools, *, epochs, batch_per_rank, lr,
                                 bf.rank_sharded(jnp.asarray(by)))
             params, state = opt.step(params, grads, state)
             step += 1
+        jax.block_until_ready(params)  # drain in-flight step programs
         accs = np.asarray(evaluate(params, tx, ty))
-        cons = float(F.consensus_distance(params))
+        cons = consensus_sq(params)
         curve.append({
             "epoch": epoch,
             "acc_mean": round(float(accs.mean()), 4),
@@ -180,7 +197,7 @@ def run_centralized(train, test, pools, *, epochs, batch_per_rank, lr,
     images, labels = train
     model = models.MnistNet()
     sample = jnp.zeros((1,) + images.shape[1:])
-    params = model.init(jax.random.PRNGKey(42), sample)["params"]
+    params = model.init(jax.random.PRNGKey(42 + seed), sample)["params"]
 
     def forward(p, x, y):
         logits = model.apply({"params": p}, x)
@@ -241,9 +258,16 @@ def main():
     ap.add_argument("--alphas", default=",".join(ALPHAS),
                     help="comma list from {0.1, 1, inf}")
     ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--seeds", type=int, default=1,
+                    help="repeat each (alpha, family) over this many "
+                    "seeds (partition + init + batch order all vary); "
+                    "curves report the seed MEAN and the artifact keeps "
+                    "per-seed finals — single-seed finals at these "
+                    "scales swing by ~0.2 acc, which is run noise, not "
+                    "family signal")
     ap.add_argument("--batch-per-rank", type=int, default=16)
     ap.add_argument("--lr", type=float, default=0.05)
-    ap.add_argument("--noise", type=float, default=1.2)
+    ap.add_argument("--noise", type=float, default=1.0)
     ap.add_argument("--samples-per-rank", type=int, default=256)
     ap.add_argument("--data-dir", default=None,
                     help="real on-disk MNIST (IDX layout, bf.load_mnist) "
@@ -279,20 +303,41 @@ def main():
     for alpha_s in alphas:
         alpha = float(alpha_s)
         arec = results["alphas"].setdefault(alpha_s, {"families": {}})
-        pools = dirichlet_partition(train[1], alpha,
-                                    np.random.RandomState(11))
-        arec["class_histogram_per_rank"] = class_histogram(train[1], pools)
+        seed_pools = [
+            dirichlet_partition(train[1], alpha,
+                                np.random.RandomState(11 + s))
+            for s in range(fargs.seeds)]
+        arec["class_histogram_per_rank"] = class_histogram(
+            train[1], seed_pools[0])
         for fam in fams:
-            print(f"alpha={alpha_s} / {fam}")
-            kwargs = dict(epochs=fargs.epochs,
-                          batch_per_rank=fargs.batch_per_rank,
-                          lr=fargs.lr)
-            if fam == "centralized":
-                curve = run_centralized(train, test, pools, **kwargs)
-            else:
-                curve = run_family(fam, train, test, pools, **kwargs)
-            arec["families"][fam] = {"curve": curve,
-                                     "final": curve[-1]}
+            curves = []
+            for s, pools in enumerate(seed_pools):
+                print(f"alpha={alpha_s} / {fam} / seed {s}")
+                kwargs = dict(epochs=fargs.epochs,
+                              batch_per_rank=fargs.batch_per_rank,
+                              lr=fargs.lr, seed=s)
+                if fam == "centralized":
+                    curves.append(run_centralized(train, test, pools,
+                                                  **kwargs))
+                else:
+                    curves.append(run_family(fam, train, test, pools,
+                                             **kwargs))
+            # consensus values live at 1e-5..1e-6: keep 3 significant
+            # digits (round(..., 4) would zero the exact signal this
+            # benchmark exists to compare)
+            mean_curve = [
+                {"epoch": e,
+                 **{k: round(float(np.mean(
+                     [c[e][k] for c in curves])), 4)
+                    for k in ("acc_mean", "acc_min", "loss")},
+                 "consensus_sq": float(f"{np.mean(
+                     [c[e]['consensus_sq'] for c in curves]):.3e}")}
+                for e in range(fargs.epochs)]
+            arec["families"][fam] = {
+                "curve_seed_mean": mean_curve,
+                "final": mean_curve[-1],
+                "final_per_seed": [c[-1] for c in curves],
+                "seeds": fargs.seeds}
             _save(results)
 
     results["note"] = (

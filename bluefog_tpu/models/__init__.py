@@ -19,6 +19,8 @@ from bluefog_tpu.models.resnet import (
 from bluefog_tpu.models.llama import (
     Llama,
     LlamaConfig,
+    chunked_xent,
+    llama_chunked_xent_loss_fn,
     llama_circular_layout,
     llama_param_specs,
     llama_pp_loss_fn,
@@ -45,6 +47,8 @@ __all__ = [
     "LlamaConfig",
     "llama_param_specs",
     "llama_pp_loss_fn",
+    "chunked_xent",
+    "llama_chunked_xent_loss_fn",
     "llama_circular_layout",
     "llama_generate",
     "init_cache",

@@ -30,7 +30,8 @@ __all__ = ["init_cache", "llama_generate"]
 
 def _decode_cfg(cfg: LlamaConfig, max_len: int, keep_tp: bool = False,
                 kv_quant: str = "none",
-                weight_quant: str = "none") -> LlamaConfig:
+                weight_quant: str = "none",
+                decode_attn: str = "xla") -> LlamaConfig:
     """Decode layout: sequence/expert mesh knobs are cleared (they are
     training-time layouts); tensor parallelism is KEPT when requested —
     a tp-sharded K/V-cached decode serves checkpoints too big for one
@@ -58,6 +59,17 @@ def _decode_cfg(cfg: LlamaConfig, max_len: int, keep_tp: bool = False,
                 "(expert_choice is non-causal and cannot decode)")
         moe = dict(capacity_factor=max(cfg.capacity_factor,
                                        float(cfg.n_experts)))
+    if decode_attn == "auto":
+        # measured dispatch (decode_*_r05.json): the fused Pallas step
+        # wins only on full-precision caches at short context; int8
+        # caches and 2k+ positions belong to the XLA lowering.  The
+        # kernel also needs a viable S tiling (>=8-row block divisor) —
+        # awkward cache lengths fall back to XLA instead of erroring.
+        from bluefog_tpu.parallel.pallas_decode import _fit_block
+
+        viable = max_len < 8 or _fit_block(max_len, 512) >= 8
+        decode_attn = ("pallas" if kv_quant == "none" and max_len <= 1024
+                       and viable else "xla")
     tp = {} if keep_tp else {"tp_axis": None, "tp_size": 1}
     # vocab_parallel is a training-time memory layout (it shards the
     # optimizer-state-bearing vocab matrices); decode clears it like the
@@ -68,8 +80,8 @@ def _decode_cfg(cfg: LlamaConfig, max_len: int, keep_tp: bool = False,
         cfg, decode=True, max_seq_len=max_len, attn_mode="full",
         attn_impl="xla", sp_axis=None, ep_axis=None, ep_size=1,
         remat=False, remat_policy="none", kv_quant=kv_quant,
-        param_quant=weight_quant, vocab_parallel=False,
-        tp_seq_shard=False, **moe, **tp)
+        param_quant=weight_quant, decode_attn=decode_attn,
+        vocab_parallel=False, tp_seq_shard=False, **moe, **tp)
 
 
 def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
@@ -93,7 +105,8 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
                    rng: Optional[jax.Array] = None,
                    max_len: Optional[int] = None,
                    mesh=None, kv_quant: str = "none",
-                   weight_quant: str = "none") -> jax.Array:
+                   weight_quant: str = "none",
+                   decode_attn: str = "auto") -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     Args:
@@ -122,6 +135,15 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
         be the quantized tree
         (:func:`bluefog_tpu.models.quant.quantize_llama_params` — do it
         once offline, not per call).
+      decode_attn: "pallas" runs single-token decode steps through the
+        fused Pallas attention kernel (one launch per layer, in-kernel
+        int8 cache dequant, float probabilities —
+        parallel/pallas_decode.py); "xla" keeps the einsum lowering;
+        "auto" (default) picks by the measured boundary — pallas for
+        full-precision caches up to 1024 positions (+13%/+6%/+3% at
+        200M B8/B32/1B), xla for int8 caches and long context
+        (decode_*_r05.json).  Measure: examples/decode_benchmark.py
+        ``--decode-attn``.
 
     Returns ``[B, T_prompt + max_new_tokens]`` int32: prompt ‖ generation.
     """
@@ -144,7 +166,8 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
             "weight_quant='int8'/'w8a8' requires params converted by "
             "quantize_llama_params (and full-precision params require "
             "weight_quant='none'); got a mismatched tree")
-    quant = dict(kv_quant=kv_quant, weight_quant=weight_quant)
+    quant = dict(kv_quant=kv_quant, weight_quant=weight_quant,
+                 decode_attn=decode_attn)
     if cfg.tp_size > 1 and mesh is not None:
         # tp-sharded decode: run the whole generate program under
         # shard_map over the tp axis — params shard by the Megatron

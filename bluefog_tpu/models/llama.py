@@ -156,6 +156,16 @@ class LlamaConfig:
     # training stays full precision.
     kv_quant: str = "none"  # none | int8
     param_quant: str = "none"  # none | int8
+    # decode_attn="pallas": single-token decode steps run the fused
+    # Pallas attention kernel (parallel/pallas_decode.py: one launch per
+    # layer, in-kernel int8 cache dequant, probabilities kept float).
+    # "xla" keeps the einsum lowering.  Measured (r05, decode_*_r05
+    # artifacts): pallas wins on FULL-PRECISION caches at short context
+    # (+13% at 200M B8, +6% B32, +3% at 1B), loses ~5% on int8 caches
+    # (its in-kernel int8->f32 convert vs XLA's fused dequant) and ~2x
+    # at 2k+ cache positions.  ``llama_generate(decode_attn="auto")``
+    # dispatches on exactly that boundary.  Prefill (t > 1) always XLA.
+    decode_attn: str = "xla"  # xla | pallas
     # Megatron-style vocab parallelism: the token embedding shards its
     # VOCAB rows and the logits head its VOCAB columns over ``tp_axis``,
     # so the two [128k x 4096] matrices stop being replicated per chip —
@@ -227,6 +237,15 @@ class LlamaConfig:
                 "param_quant is inference-only (int8 kernels are not "
                 "differentiable); set it through llama_generate and "
                 "convert params with quantize_llama_params")
+        if self.decode_attn not in ("xla", "pallas"):
+            raise ValueError(
+                f"decode_attn {self.decode_attn!r} not in "
+                "('xla', 'pallas')")
+        if self.decode_attn == "pallas" and not self.decode:
+            raise ValueError(
+                "decode_attn='pallas' is a decode-time knob (the fused "
+                "kernel serves single-token cached steps); set it "
+                "through llama_generate")
         if self.vocab_parallel:
             if self.tp_size <= 1 or self.tp_axis is None:
                 raise ValueError("vocab_parallel requires tensor "
@@ -800,11 +819,26 @@ class Attention(nn.Module):
             ck.value, cks.value = kq_all, ks_all
             cv.value, cvs.value = vq_all, vs_all
             ci.value = idx + t
-            if cfg.param_quant == "w8a8":
+            if cfg.decode_attn == "pallas" and t == 1:
+                # fused single-launch decode step: in-kernel dequant,
+                # probabilities kept float (parallel/pallas_decode.py)
+                from bluefog_tpu.parallel.pallas_decode import (
+                    decode_attention_int8)
+                return decode_attention_int8(q, kq_all, ks_all, vq_all,
+                                             vs_all, idx)
+            if cfg.param_quant == "w8a8" and max_len <= 1024:
                 # fully-integer attention: both contractions run s8xs8
                 # on the MXU against the raw int8 cache — the cache
                 # streams at native-dot rates (~600 GB/s measured)
-                # instead of the ~280 GB/s convert-into-dot path
+                # instead of the ~280 GB/s convert-into-dot path.
+                # LONG CONTEXT (static gate on the cache length) takes
+                # the dequant path below instead: the integer path's
+                # per-step probability re-quantization is VPU work
+                # linear in S x heads and LOSES past ~1k positions
+                # (round 5 measured, benchmarks/decode_200m_v5e1_r05:
+                # w8a8 8.1k vs weight-only 9.3k tok/s at prompt 2048
+                # before this gate; 9.6k after) — the round-4
+                # "rule of thumb" is now the code's own dispatch.
                 return _cached_attention_int8(q, kq_all, ks_all, vq_all,
                                               vs_all, idx)
             k_all = kq_all.astype(jnp.float32) * ks_all[..., None]
@@ -819,6 +853,9 @@ class Attention(nn.Module):
             v_all = lax.dynamic_update_slice(
                 cv.value, v.astype(cfg.dtype), (zero, zero, idx, zero))
             ck.value, cv.value, ci.value = k_all, v_all, idx + t
+        if cfg.decode_attn == "pallas" and t == 1:
+            from bluefog_tpu.parallel.pallas_decode import decode_attention
+            return decode_attention(q, k_all, v_all, idx)
         # queries live at global positions [idx, idx+t); the causal mask
         # there also excludes the cache's unwritten (zero) tail
         return _cached_attention(q, k_all, v_all, idx)
@@ -1139,10 +1176,18 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, pos_offset=0):
+    def __call__(self, tokens, pos_offset=0, return_hidden=False):
         """tokens: [B, T_local] int32 -> logits [B, T_local, vocab] f32
         (with ``cfg.vocab_parallel``: [B, T_local, vocab/tp] — this
-        shard's columns; train against ``vocab_parallel_xent``)."""
+        shard's columns; train against ``vocab_parallel_xent``).
+
+        ``return_hidden=True`` stops after the final RMSNorm and returns
+        the [B, T_local, dim] hidden states instead of logits — the
+        entry point for the chunked head+cross-entropy path
+        (``llama_chunked_xent_loss_fn``), which never materializes the
+        full [B, T, vocab] logits.  Init with the default so the head
+        params exist; apply-with-return_hidden simply leaves them
+        unused."""
         cfg = self.cfg
         assert tokens.shape[1] <= cfg.max_seq_len, (
             f"sequence shard {tokens.shape[1]} exceeds max_seq_len "
@@ -1196,6 +1241,8 @@ class Llama(nn.Module):
             # the other T-1 head matmuls and the [B, T, vocab] logits
             # buffer (at 8k prompt x 128k vocab that is ~4 GB of f32)
             x = x[:, -1:]
+        if return_hidden:
+            return x
         if cfg.param_quant != "none":
             # int8 head: HBM streams the int8 kernel, the per-channel
             # scale lands in f32 — the logits keep f32 dynamic range
@@ -1392,6 +1439,70 @@ def llama_pp_loss_fn(cfg: LlamaConfig, *, pp_axis: str, n_stages: int,
             # (identical to it when n_micro == 1).
             loss = loss + cfg.moe_aux_weight * aux_sum / n_micro
         return loss
+
+    return loss_fn
+
+
+def chunked_xent(h, w_kernel, targets, *, n_chunks: int = 8,
+                 dot_in_fp32: bool = True):
+    """Next-token cross-entropy computed CHUNK BY CHUNK over the sequence
+    so the full ``[B, T, vocab]`` logits never materialize.
+
+    ``h``: [B, T, dim] final-norm hidden states (``Llama.__call__`` with
+    ``return_hidden=True``); ``w_kernel``: [dim, vocab] head kernel;
+    ``targets``: [B, T] int32.  Each of the ``n_chunks`` sequence chunks
+    computes its logits, log-sum-exp and target gather inside a
+    ``jax.checkpoint`` region iterated by ``lax.map``: forward holds one
+    [B, T/n_chunks, vocab] block at a time, backward recomputes it — at
+    8B scale (seq 4096, vocab 128k) that is 16 GB of f32 logits (+ the
+    same again for their cotangent) that never exist at once.  Exact:
+    same f32 softmax math as the monolithic head (parity in
+    tests/test_models.py)."""
+    b, s, _ = h.shape
+    if s % n_chunks:
+        raise ValueError(f"seq len {s} % n_chunks {n_chunks} != 0")
+    import optax
+
+    c = s // n_chunks
+    dtype = jnp.float32 if dot_in_fp32 else h.dtype
+    hc = jnp.swapaxes(h.reshape(b, n_chunks, c, h.shape[-1]), 0, 1)
+    tc = jnp.swapaxes(targets.reshape(b, n_chunks, c), 0, 1)
+
+    @jax.checkpoint
+    def one(args):
+        hx, t = args
+        logits = jnp.dot(hx.astype(dtype),
+                         w_kernel.astype(dtype)).astype(jnp.float32)
+        return jnp.sum(
+            optax.softmax_cross_entropy_with_integer_labels(logits, t))
+
+    return jnp.sum(lax.map(one, (hc, tc))) / (b * s)
+
+
+def llama_chunked_xent_loss_fn(cfg: LlamaConfig, *, n_chunks: int = 8):
+    """Build ``loss_fn(params, (inputs, targets))`` that runs the decoder
+    stack normally but the head + cross-entropy through ``chunked_xent``
+    (the fused/blockwise head path — the full logits tensor is the
+    single largest activation of the train step at every size).  Not
+    compatible with ``vocab_parallel`` (which has its own exact sharded
+    xent) or MoE-aux configs (use the plain loss with intermediates)."""
+    if cfg.vocab_parallel:
+        raise ValueError("chunked xent: use vocab_parallel_xent with "
+                         "vocab_parallel configs")
+    if cfg.tp_seq_shard:
+        raise ValueError("chunked xent: hidden states are seq-sharded "
+                         "under tp_seq_shard but targets are not")
+    if cfg.n_experts and cfg.moe_aux_weight > 0.0:
+        raise ValueError("chunked xent does not collect MoE aux "
+                         "intermediates; use the plain loss")
+    model = Llama(cfg)
+
+    def loss_fn(params, batch):
+        inp, tgt = batch
+        h = model.apply(params, inp, return_hidden=True)
+        w = params["params"]["output"]["kernel"]
+        return chunked_xent(h, w, tgt, n_chunks=n_chunks,
+                            dot_in_fp32=cfg.logits_dot_in_fp32)
 
     return loss_fn
 

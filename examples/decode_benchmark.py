@@ -48,6 +48,12 @@ parser.add_argument("--head", default="f32", choices=["f32", "bf16"],
                     help="logits matmul precision (ignored whenever "
                     "--weight-quant is not 'none': the int8 head "
                     "streams 1 B/el either way)")
+parser.add_argument("--decode-attn", default="auto",
+                    choices=["auto", "xla", "pallas"],
+                    help="decode-step attention lowering: XLA einsums, "
+                    "the fused Pallas kernel (parallel/pallas_decode.py), "
+                    "or the measured auto dispatch (pallas for full-"
+                    "precision caches <= 1024 positions, xla otherwise)")
 parser.add_argument("--repeats", type=int, default=3)
 args = parser.parse_args()
 
@@ -119,7 +125,8 @@ def main():
         gen = lambda: llama_generate(
             variables, cfg, prompt, n_new,
             max_len=args.prompt_len + args.new_tokens,
-            kv_quant=args.kv_quant, weight_quant=args.weight_quant)
+            kv_quant=args.kv_quant, weight_quant=args.weight_quant,
+            decode_attn=args.decode_attn)
         device_fetch(gen())  # compile + run once
         rtt = fetch_overhead()
         times = []
@@ -151,6 +158,7 @@ def main():
         "batch": args.batch_size, "prompt_len": args.prompt_len,
         "new_tokens": args.new_tokens, "dtype": args.dtype,
         "kv_quant": args.kv_quant, "weight_quant": args.weight_quant,
+        "decode_attn": args.decode_attn,
         "head": "int8" if args.weight_quant != "none" else args.head,
         "decode_tokens_per_sec": round(toks_per_sec, 1),
         "per_seq_tokens_per_sec": round(toks_per_sec / args.batch_size, 1),

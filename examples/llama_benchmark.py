@@ -81,6 +81,10 @@ parser.add_argument("--bf16-logits", action="store_true",
 parser.add_argument("--no-remat", action="store_true",
                     help="disable rematerialization (when HBM allows, "
                     "saves the recompute FLOPs)")
+parser.add_argument("--xent-chunks", type=int, default=0,
+                    help="compute the head + cross-entropy in this many "
+                    "sequence chunks (models.chunked_xent: the full "
+                    "[B,S,V] logits never materialize; 0 = monolithic)")
 parser.add_argument("--remat-policy", default="none",
                     choices=["none", "dots", "everything"])
 parser.add_argument("--layers", type=int, default=0,
@@ -175,6 +179,11 @@ def main():
         loss_fn = llama_pp_loss_fn(cfg, pp_axis="pp", n_stages=n_pp,
                                    n_micro=n_micro,
                                    n_loops=args.pp_loops)
+    elif args.xent_chunks > 0:
+        assert n_sp == 1 and not args.experts, \
+            "--xent-chunks: plain dp/tp configs only"
+        loss_fn = models.llama_chunked_xent_loss_fn(
+            cfg, n_chunks=args.xent_chunks)
     else:
         want_aux = cfg.n_experts > 0 and cfg.moe_aux_weight > 0.0
 
