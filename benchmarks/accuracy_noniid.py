@@ -325,14 +325,16 @@ def main():
             # consensus values live at 1e-5..1e-6: keep 3 significant
             # digits (round(..., 4) would zero the exact signal this
             # benchmark exists to compare)
-            mean_curve = [
-                {"epoch": e,
-                 **{k: round(float(np.mean(
-                     [c[e][k] for c in curves])), 4)
-                    for k in ("acc_mean", "acc_min", "loss")},
-                 "consensus_sq": float(f"{np.mean(
-                     [c[e]['consensus_sq'] for c in curves]):.3e}")}
-                for e in range(fargs.epochs)]
+            def _epoch_mean(e):
+                row = {"epoch": e}
+                for k in ("acc_mean", "acc_min", "loss"):
+                    row[k] = round(float(np.mean(
+                        [c[e][k] for c in curves])), 4)
+                cons = np.mean([c[e]["consensus_sq"] for c in curves])
+                row["consensus_sq"] = float(f"{cons:.3e}")
+                return row
+
+            mean_curve = [_epoch_mean(e) for e in range(fargs.epochs)]
             arec["families"][fam] = {
                 "curve_seed_mean": mean_curve,
                 "final": mean_curve[-1],

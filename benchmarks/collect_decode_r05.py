@@ -60,6 +60,7 @@ def main(lines_path):
                 "batch": best["batch"],
                 "kv_quant": best["kv_quant"],
                 "weight_quant": best["weight_quant"],
+                "decode_attn": best["decode_attn"],
                 "decode_tokens_per_sec": best["decode_tokens_per_sec"],
                 "vs_bf16_same_session": round(
                     best["decode_tokens_per_sec"]

@@ -64,12 +64,15 @@ def _decode_cfg(cfg: LlamaConfig, max_len: int, keep_tp: bool = False,
         # wins only on full-precision caches at short context; int8
         # caches and 2k+ positions belong to the XLA lowering.  The
         # kernel also needs a viable S tiling (>=8-row block divisor) —
-        # awkward cache lengths fall back to XLA instead of erroring.
+        # awkward cache lengths fall back to XLA instead of erroring —
+        # and a REAL TPU: off-TPU the kernel would run in Pallas
+        # interpret mode, orders of magnitude slower than the einsums.
         from bluefog_tpu.parallel.pallas_decode import _fit_block
 
         viable = max_len < 8 or _fit_block(max_len, 512) >= 8
         decode_attn = ("pallas" if kv_quant == "none" and max_len <= 1024
-                       and viable else "xla")
+                       and viable and jax.default_backend() == "tpu"
+                       else "xla")
     tp = {} if keep_tp else {"tp_axis": None, "tp_size": 1}
     # vocab_parallel is a training-time memory layout (it shards the
     # optimizer-state-bearing vocab matrices); decode clears it like the

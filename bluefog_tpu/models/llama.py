@@ -836,8 +836,8 @@ class Attention(nn.Module):
                 # per-step probability re-quantization is VPU work
                 # linear in S x heads and LOSES past ~1k positions
                 # (round 5 measured, benchmarks/decode_200m_v5e1_r05:
-                # w8a8 8.1k vs weight-only 9.3k tok/s at prompt 2048
-                # before this gate; 9.6k after) — the round-4
+                # w8a8 8.1k vs weight-only 10.1k tok/s at prompt 2048
+                # before this gate; 10.9k after) — the round-4
                 # "rule of thumb" is now the code's own dispatch.
                 return _cached_attention_int8(q, kq_all, ks_all, vq_all,
                                               vs_all, idx)
