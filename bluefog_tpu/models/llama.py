@@ -59,15 +59,17 @@ class LlamaConfig:
     rope_scaling_original_max_len: int = 8192
     # Tile sizes for the full-sequence Pallas flash kernel (q tile /
     # k tile; both clamped to t).  Measured on v5e (round 3): 1024 q
-    # tiles beat 512 by +18% tokens/s at 200M and +13% at 1B end-to-end,
-    # and the 2048 k tile wins another ~15% on the attention forward —
-    # at head_dim 64 the score matmul contracts only 64 deep, so big
-    # tiles are what amortize the MXU.  The backward pass auto-shrinks
-    # its q tile to keep its two score-sized f32 intermediates inside
-    # the 16 MB scoped VMEM (see _flash_bwd_impl), so the big k tile is
-    # safe to train with.
+    # tiles beat 512 by +18% tokens/s at 200M and +13% at 1B end-to-end.
+    # Round 5 added causal BLOCK SKIPPING (fully-masked k blocks execute
+    # nothing, pallas_attention._block_live), which flips the k-tile
+    # optimum: a k block spanning the whole sequence never skips, while
+    # 1024-wide k blocks skip a quarter of the grid at seq 2048 —
+    # re-measured end-to-end, q1024/k1024 beats the round-3 q1024/k2048
+    # at BOTH 200M (+1.9%) and 1B (+2.3%).  The backward pass
+    # auto-shrinks its q tile to keep its two score-sized f32
+    # intermediates inside the 16 MB scoped VMEM (_flash_bwd_impl).
     attn_flash_block_size: int = 1024
-    attn_flash_block_k: int = 2048
+    attn_flash_block_k: int = 1024
     sp_axis: Optional[str] = None  # mesh axis for ring mode
     # Tensor (Megatron-style) parallelism: heads + FFN hidden sharded over
     # ``tp_axis`` (``tp_size`` shards, static).  Column-parallel kernels

@@ -73,6 +73,9 @@ parser.add_argument("--attn-impl", default="xla", choices=["xla", "flash"])
 parser.add_argument("--attn-block-size", type=int, default=0,
                     help="flash/blockwise attention tile size "
                     "(0 = config default)")
+parser.add_argument("--attn-block-k", type=int, default=0,
+                    help="flash K/V tile size alone (0 = config "
+                    "default; --attn-block-size sets both)")
 parser.add_argument("--scan-layers", action="store_true",
                     help="nn.scan the decoder stack (O(1) compile in depth)")
 parser.add_argument("--bf16-logits", action="store_true",
@@ -126,6 +129,8 @@ def make_config():
         base.update(attn_block_size=args.attn_block_size,
                     attn_flash_block_size=args.attn_block_size,
                     attn_flash_block_k=args.attn_block_size)
+    if args.attn_block_k:
+        base.update(attn_flash_block_k=args.attn_block_k)
     if args.model == "tiny":
         return models.LlamaConfig.tiny(**base)
     if args.model == "200m":
