@@ -107,7 +107,16 @@ def _kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     kj = pl.program_id(2)
     n_k = pl.num_programs(2)
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)      # [block_q, D]
+    # NATIVE-dtype dot operands with f32 accumulation: numerically
+    # IDENTICAL for the score matmul (the MXU multiplies the same bf16
+    # mantissas either way); the P·V dot rounds the f32 probabilities
+    # to the value dtype (f32 inputs stay exact; bf16 inputs get the
+    # standard FlashAttention mixed-precision PV dot).  Measured
+    # end-to-end NEUTRAL (docs/performance.md round 5: Mosaic already
+    # absorbed the old operand upcasts) — kept as the cleaner form, not
+    # as a perf lever; the kernel's cost sits in the softmax's
+    # cross-lane reductions, also measured there.
+    q = q_ref[0]                          # [block_q, D]
     block_q, d = q.shape
     block_k = k_ref.shape[1]
 
@@ -122,11 +131,11 @@ def _kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _():
-        k_blk = k_ref[0].astype(jnp.float32)  # [block_k, D]
-        v_blk = v_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0]                  # [block_k, D]
+        v_blk = v_ref[0]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
         if causal:
             s = _apply_causal_mask(s, q_off_ref[0], kv_off_ref[0], qi, kj)
         m, l, acc = m_ref[:], l_ref[:], acc_ref[:]
@@ -141,7 +150,7 @@ def _kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[:] = new_m
         l_ref[:] = l * corr + jnp.sum(p, axis=-1)
         acc_ref[:] = acc * corr[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kj == n_k - 1)
@@ -233,8 +242,9 @@ def _bwd_dq_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
     kj = pl.program_id(2)
     n_k = pl.num_programs(2)
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    # native-dtype dot operands, f32 accumulation (see _kernel's note)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     block_q, d = q.shape
@@ -249,15 +259,15 @@ def _bwd_dq_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
 
     @pl.when(live)
     def _():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
         p = _recompute_p(q, k, lse, q_off_ref[0], kv_off_ref[0], qi, kj,
                          scale, causal)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
         acc_ref[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
     @pl.when(kj == n_k - 1)
@@ -273,8 +283,9 @@ def _bwd_dkv_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
     t = pl.program_id(2)
     n_t = pl.num_programs(2)
     qi = t // group
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    # native-dtype dot operands, f32 accumulation (see _kernel's note)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     block_q, d = q.shape
@@ -291,18 +302,18 @@ def _bwd_dkv_kernel(q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref,
 
     @pl.when(live)
     def _():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
         p = _recompute_p(q, k, lse, q_off_ref[0], kv_off_ref[0], qi, kj,
                          scale, causal)
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
     @pl.when(t == n_t - 1)
@@ -452,9 +463,12 @@ def flash_attention(
 ) -> jax.Array:
     """Flash attention.  q: [B, T_q, H, D]; k/v: [B, T_k, H_kv, D] (GQA
     served by index mapping, never materialized).  Differentiable
-    (recompute-based backward)."""
+    (recompute-based backward).  Mixed-dtype q/k/v are normalized to
+    q's dtype (the kernels feed operands to the MXU natively)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
     return _flash(q, k, v, q_offset, kv_offset, causal, scale, block_q,
                   block_k, _auto_interpret(interpret))
 
@@ -478,6 +492,8 @@ def flash_attention_with_lse(
     needed to merge partial attentions across ring steps."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
     return _flash_fwd_impl(q, k, v, q_offset, kv_offset, causal=causal,
                            scale=scale, block_q=block_q, block_k=block_k,
                            interpret=_auto_interpret(interpret))
