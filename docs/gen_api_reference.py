@@ -45,6 +45,8 @@ MODULES = [
     ("bluefog_tpu.parallel.ulysses", "all-to-all sequence parallelism"),
     ("bluefog_tpu.parallel.pipeline", "GPipe + circular pipeline schedules"),
     ("bluefog_tpu.parallel.pallas_attention", "Pallas flash attention"),
+    ("bluefog_tpu.parallel.pallas_decode",
+     "Pallas fused decode-attention step"),
     ("bluefog_tpu.windows", "one-sided window ops (win_put/get/update)"),
     ("bluefog_tpu.compressor", "gradient compression (TopK/RandomK/int8)"),
     ("bluefog_tpu.checkpoint", "orbax checkpoint/resume wrappers"),
