@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 __all__ = ["device_fetch", "fetch_overhead", "timed",
+           "chain_time", "fwd_bwd_time",
            "chip_peak_flops", "chip_hbm_bandwidth", "compiled_step_flops",
            "mfu", "hlo_collective_bytes"]
 
@@ -187,3 +188,73 @@ def timed(run_steps, sync_value_fn, overhead: float = None) -> float:
     run_steps()
     device_fetch(sync_value_fn())
     return max(time.perf_counter() - t0 - overhead, 1e-9)
+
+
+def chain_time(f, params, x0, n=20, reps=3):
+    """Per-iteration seconds of ``x <- barrier(f(params, x)*eps + x0)``
+    iterated INSIDE one jitted fori_loop — per-call tunnel dispatch is
+    ~3 ms on this rig and would floor every sub-3ms op if the chain were
+    a host loop.  ``params`` ride as jit ARGUMENTS (closure constants
+    >100 MB overflow the remote compile transport).  Promoted verbatim
+    from benchmarks/llama_roofline.py (round 5), whose composition
+    reproduces the measured 1B train step exactly — the validation that
+    makes this the trusted micro-timing harness on the tunnel rig.
+    """
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chained(p, x):
+        def body(i, x):
+            y = f(p, x)
+            if y.shape != x0.shape:
+                # consume EVERY element (a slice would let XLA narrow
+                # the producing dot to the sliced columns — observed as
+                # a 116% "MFU" on the vocab head)
+                y = jnp.mean(y.astype(jnp.float32), axis=-1,
+                             keepdims=True)
+                y = jnp.broadcast_to(y, x0.shape[:-1] + (1,))
+            y = (y.astype(jnp.float32) * 1e-30).astype(x0.dtype)
+            return jax.lax.optimization_barrier(x0 + y)
+        return jax.lax.fori_loop(0, n, body, x)
+
+    device_fetch(chained(params, x0)[..., :1])
+    ov = fetch_overhead()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        device_fetch(chained(params, x0)[..., :1])
+        times.append((time.perf_counter() - t0 - ov) / n)
+    return float(np.median(times))
+
+
+def fwd_bwd_time(f, x0, params, n=20, reps=3):
+    """fwd+bwd seconds of y = f(params, x) with grads wrt both, chained
+    through dx inside one jitted fori_loop (see chain_time)."""
+    import jax.numpy as jnp
+
+    def loss(p, x):
+        return jnp.sum(f(p, x).astype(jnp.float32) ** 2)
+
+    grad = jax.grad(loss, argnums=(0, 1))
+
+    @jax.jit
+    def chained(p, x):
+        def body(i, x):
+            dp, dx = grad(p, x)
+            # consume EVERY gradient: an unused dp would let XLA DCE
+            # the dW matmuls and report a 2N-FLOP backward as 4N
+            dp_sum = sum(jnp.sum(leaf.astype(jnp.float32)) * 1e-30
+                         for leaf in jax.tree.leaves(dp))
+            return jax.lax.optimization_barrier(
+                (dx.astype(jnp.float32) * 1e-30 + dp_sum
+                 ).astype(x0.dtype) + x0)
+        return jax.lax.fori_loop(0, n, body, x)
+
+    device_fetch(chained(params, x0)[..., :1])
+    ov = fetch_overhead()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        device_fetch(chained(params, x0)[..., :1])
+        times.append((time.perf_counter() - t0 - ov) / n)
+    return float(np.median(times))

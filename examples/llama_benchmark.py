@@ -69,7 +69,8 @@ parser.add_argument("--pp-loops", type=int, default=1,
 parser.add_argument("--microbatches", type=int, default=0,
                     help="pipeline microbatches (default 2*pp; the "
                     "circular schedule requires at least pp)")
-parser.add_argument("--attn-impl", default="xla", choices=["xla", "flash"])
+parser.add_argument("--attn-impl", default="xla",
+                    choices=["xla", "flash", "splash"])
 parser.add_argument("--attn-block-size", type=int, default=0,
                     help="flash/blockwise attention tile size "
                     "(0 = config default)")
@@ -123,8 +124,8 @@ def make_config():
     if args.sp > 1:
         base.update(attn_mode=args.sp_mode, sp_axis="sp",
                     attn_impl=args.attn_impl)
-    elif args.attn_impl == "flash":
-        base.update(attn_impl="flash")
+    elif args.attn_impl != "xla":
+        base.update(attn_impl=args.attn_impl)
     if args.attn_block_size:
         base.update(attn_block_size=args.attn_block_size,
                     attn_flash_block_size=args.attn_block_size,
