@@ -46,6 +46,7 @@ import argparse
 import dataclasses
 import functools
 import json
+import os
 import time
 
 import jax
@@ -61,7 +62,8 @@ from bluefog_tpu.models.llama import Block
 TP = 8
 B, S = 2, 4096
 V5E_LINK_GBPS = 200.0  # per-link one-way, the scaling projection's figure
-OUT = "benchmarks/llama_8b_measured_r05.json"
+OUT = "benchmarks/llama_8b_measured_r06.json"
+SEED_FROM = "benchmarks/llama_8b_measured_r05.json"  # resume r05 timings
 
 
 import dataclasses as _dc
@@ -246,7 +248,7 @@ def flops_8b(seq=S, batch=B):
     return base + attn
 
 
-def ici_terms(step_chip_s):
+def ici_terms():
     """Analytic ICI time per step for the tp8_seqshard x dp layout."""
     link = V5E_LINK_GBPS * 1e9 / 8  # bytes/s one-way
     act_bytes = B * S * 4096 * 2  # bf16 [B, S, D]
@@ -266,10 +268,8 @@ def ici_terms(step_chip_s):
         "note": "ring collective cost (n-1)/n x bytes at "
                 f"{V5E_LINK_GBPS} Gbps/link one-way; dp uses the "
                 "default_pod_schedule mean congestion 16/7 with int8 "
-                "wire (scaling_projection_r05.json)",
-        "no_overlap_s": round(tp_total + dp_int8, 4),
-        "full_overlap_s": round(max(0.0, tp_total + dp_int8
-                                    - step_chip_s), 4),
+                "wire (scaling_projection_r05.json); overlap discounts "
+                "come from the defended fractions, not a spread",
     }
 
 
@@ -304,13 +304,16 @@ def run_train_part(result, save):
               f"grad {t_grad*1e3:.1f} ms", flush=True)
         save()  # the tunnel can drop mid-compile; keep what we have
     # round-5 final lever: the splash backend (fused-bwd library
-    # kernel, parallel/splash.py) at its measured-best q1024/kv1024
-    skey = "splash_q1024_kv1024"
+    # kernel, parallel/splash.py) at the config's own block sizes —
+    # the row key is DERIVED from the measured config, not hardcoded
+    # (round-5 advice: a changed default would silently mislabel the row)
+    splash_cfg = shard_cfg(attn_impl="splash")
+    skey = (f"splash_q{splash_cfg.attn_flash_block_size}"
+            f"_kv{splash_cfg.attn_flash_block_k}")
     if "fwd_bwd_s" not in sweep.get(skey, {}):
         print("[train] splash shard layer", flush=True)
         try:
-            ts_fwd, ts_grad, _ = measure_layer(
-                shard_cfg(attn_impl="splash"))
+            ts_fwd, ts_grad, _ = measure_layer(splash_cfg)
             sweep[skey] = {"fwd_s": round(ts_fwd, 4),
                            "fwd_bwd_s": round(ts_grad, 4)}
         except Exception as e:  # noqa: BLE001 — record, keep flash
@@ -367,9 +370,7 @@ def run_train_part(result, save):
     t_layer = t_fwd + t_grad  # remat=everything: bwd recomputes fwd
     head_best = min(t_head, t_head_chunked)
     t_chip = 32 * t_layer + t_embed + head_best + t_opt
-    ici = ici_terms(t_chip)
-    t_none = t_chip + ici["no_overlap_s"]
-    t_full = max(t_chip, t_chip + ici["full_overlap_s"])
+    ici = ici_terms()
     flops = flops_8b()
     peak = chip_peak_flops()
     result["train"] = {
@@ -395,24 +396,41 @@ def run_train_part(result, save):
         "ici_analytic": ici,
         "composition": {
             "formula": "t_chip = 32*(fwd+fwd_bwd) + embed + "
-                       "min(head, head_chunked) + opt; no_overlap = "
-                       "t_chip + t_ici; full_overlap = max(t_chip, "
-                       "t_ici)",
+                       "min(head, head_chunked) + opt; t_step = t_chip "
+                       "+ (1-f_tp)*t_tp + (1-f_dp)*t_dp with f_* the "
+                       "DEFENDED overlap fractions (overlap record; "
+                       "benchmarks/llama_8b_overlap.py)",
             "t_chip_s": round(t_chip, 4),
-            "t_step_no_overlap_s": round(t_none, 4),
-            "t_step_full_overlap_s": round(t_full, 4),
         },
         "projected": {
             "flops_per_step_per_dp_rank": flops,
             "chip_peak_flops": peak,
-            "mfu_no_overlap": round(flops / TP / t_none / peak, 4),
-            "mfu_full_overlap": round(flops / TP / t_full / peak, 4),
-            "tokens_per_sec_v5e128_dp16_no_overlap": round(
-                16 * B * S / t_none, 1),
-            "tokens_per_sec_v5e128_dp16_full_overlap": round(
-                16 * B * S / t_full, 1),
         },
     }
+    compose_defended(result)
+
+
+def compose_defended(result):
+    """Single defended-MFU composition: the overlap record's
+    overlappable-bytes fractions discount each ICI term.  With no
+    overlap record yet (run ``--part overlap`` or
+    benchmarks/llama_8b_overlap.py) the fractions default to 0.0 —
+    conservative, but still ONE number, not a spread."""
+    if "overlap" not in result:
+        result["overlap"] = {
+            "note": "no overlap audit yet — fractions conservatively "
+                    "0.0; run benchmarks/llama_8b_overlap.py (or "
+                    "--part overlap) for the defended fractions",
+            "dp_neighbor_exchange": {"fraction": 0.0,
+                                     "basis": "unaudited"},
+            "tp_allgather_reducescatter": {"fraction": 0.0,
+                                           "basis": "unaudited"},
+        }
+    try:
+        from llama_8b_overlap import rebase_projection
+    except ImportError:  # imported as a package module
+        from benchmarks.llama_8b_overlap import rebase_projection
+    rebase_projection(result)
 
 
 def run_decode_part(result, batch=4, prompt_len=256, new_tokens=256):
@@ -488,23 +506,49 @@ def run_decode_part(result, batch=4, prompt_len=256, new_tokens=256):
     }
 
 
+def run_overlap_part(args):
+    """Delegate the overlap audit to benchmarks/llama_8b_overlap.py in
+    a FRESH process: the audit AOT-compiles on a 16-virtual-device CPU
+    mesh, which needs XLA_FLAGS/JAX_PLATFORMS pinned before jax
+    initializes (impossible in this already-initialized process)."""
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "llama_8b_overlap.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # the audit pins cpu itself
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    # absolute paths: the child runs with cwd=repo_root, the parent's
+    # relative --out must still mean the SAME file in both processes
+    subprocess.run(
+        [sys.executable, script,
+         "--out", os.path.abspath(args.out),
+         "--seed-from", os.path.join(repo_root, SEED_FROM)],
+        check=True, env=env, cwd=repo_root)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--part", default="all",
-                    choices=["train", "decode", "all"])
+                    choices=["train", "decode", "overlap", "all"])
     ap.add_argument("--out", default=OUT)
     args = ap.parse_args()
-    assert jax.default_backend() == "tpu", "run on the real chip"
-    import os
+    if args.part != "overlap":
+        assert jax.default_backend() == "tpu", "run on the real chip"
     result = {}
-    if os.path.exists(args.out):  # resume past tunnel drops
-        with open(args.out) as fh:
+    src = args.out if os.path.exists(args.out) else SEED_FROM
+    if os.path.exists(src):  # resume past tunnel drops / seed from r05
+        with open(src) as fh:
             result = json.load(fh)
     result.update({
         "model": "llama3_8b", "chip": "v5e-1",
         "method": "per-component wall timings on the real chip "
                   "(data-dependent chains, fetch-overhead subtracted), "
-                  "composed per the stated formula; ICI analytic",
+                  "composed per the stated formula; ICI analytic; "
+                  "overlap fractions from the scheduled-HLO "
+                  "overlappable-bytes audit (llama_8b_overlap.py)",
     })
     def save():
         with open(args.out, "w") as fh:
@@ -515,8 +559,12 @@ def main():
         save()
     if args.part in ("decode", "all"):
         run_decode_part(result)
-        with open(args.out, "w") as fh:
-            json.dump(result, fh, indent=1)
+        save()
+    if args.part in ("overlap", "all"):
+        save()
+        run_overlap_part(args)  # writes/updates args.out itself
+        with open(args.out) as fh:
+            result = json.load(fh)
     print(json.dumps(result.get("train", {}).get("projected", {}))
           if "train" in result else "")
     print(f"wrote {args.out}")

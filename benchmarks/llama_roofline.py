@@ -92,7 +92,7 @@ def main():
 
     params = (wq, wk, wv, wo)
     t_fwd = chain_time(qkvo, params, x0)
-    t_tot = fwd_bwd_time(qkvo, x0, params)
+    t_tot = fwd_bwd_time(qkvo, params, x0)
     p_qkvo = sum(w.size for w in params)
     record("qkvo", t_fwd, t_tot,
            (2 * p_qkvo * tokens, 4 * p_qkvo * tokens, 6 * p_qkvo * tokens))
@@ -109,7 +109,7 @@ def main():
 
     params = (w1, w3, w2)
     t_fwd = chain_time(ffn, params, x0)
-    t_tot = fwd_bwd_time(ffn, x0, params)
+    t_tot = fwd_bwd_time(ffn, params, x0)
     p_ffn = sum(w.size for w in params)
     record("ffn", t_fwd, t_tot,
            (2 * p_ffn * tokens, 4 * p_ffn * tokens, 6 * p_ffn * tokens))
@@ -124,7 +124,7 @@ def main():
                                block_q=1024, block_k=1024)
 
     t_fwd = chain_time(attn, (kv0, kv0), q0)
-    t_tot = fwd_bwd_time(attn, q0, (kv0, kv0))
+    t_tot = fwd_bwd_time(attn, (kv0, kv0), q0)
     # causal attention: fwd 2 matmuls (QK^T, PV) = 4*B*H*S^2*hd ops
     # halved by the mask; bwd 2x
     a_fwd = 4 * B * n_q * S * S * hd // 2
@@ -146,7 +146,7 @@ def main():
         return x + norm(x)
 
     t_fwd = chain_time(elem, gamma, x0)
-    t_tot = fwd_bwd_time(elem, x0, gamma)
+    t_tot = fwd_bwd_time(elem, gamma, x0)
     record("elementwise", t_fwd, t_tot, (1e9, 1e9, 1e9))  # VPU: MFU n/a
     rows["elementwise"].pop("mfu_fwd")
     rows["elementwise"].pop("mfu_fwd_bwd")
@@ -158,7 +158,7 @@ def main():
         return jnp.dot(x.astype(jnp.float32), p)
 
     t_fwd = chain_time(head, wh, x0, n=4)
-    t_tot = fwd_bwd_time(head, x0, wh, n=4)
+    t_tot = fwd_bwd_time(head, wh, x0, n=4)
     p_head = wh.size
     record("head_f32", t_fwd, t_tot,
            (2 * p_head * tokens, 4 * p_head * tokens, 6 * p_head * tokens))

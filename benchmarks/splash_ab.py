@@ -59,7 +59,7 @@ def _bench(f, q0, kv0):
     attention backward, every gradient consumed.
     """
     return (chain_time(f, kv0, q0, n=_ITERS),
-            fwd_bwd_time(f, q0, kv0, n=_ITERS))
+            fwd_bwd_time(f, kv0, q0, n=_ITERS))
 
 
 def bench_ours(b, h, kv, s, d, dtype, block=1024):

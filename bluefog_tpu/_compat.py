@@ -1,0 +1,60 @@
+"""Version compatibility shims for the jax API surface this package uses.
+
+The codebase targets the modern ``jax.shard_map(f, mesh=..., in_specs=...,
+out_specs=..., check_vma=...)`` entry point.  Older jax releases (< 0.5)
+ship the same functionality as ``jax.experimental.shard_map.shard_map``
+with the replication check spelled ``check_rep``.  Importing this module
+(done unconditionally from ``bluefog_tpu.__init__``) installs a
+signature-adapting alias so every call site can use the one modern
+spelling regardless of the installed jax.
+
+Nothing here changes behavior on a jax that already has ``jax.shard_map``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["install"]
+
+
+def _make_legacy_shard_map():
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    # NOTE: installed onto the PROCESS-GLOBAL jax module, so a cohosted
+    # library that feature-detects `hasattr(jax, "shard_map")` will see
+    # it too — accept mesh positionally (like the legacy function) so
+    # such callers do not hit a keyword-only TypeError.
+    def shard_map(f, mesh=None, *, in_specs, out_specs, check_vma=None,
+                  **kwargs):
+        # modern name -> legacy name; default stays the legacy default
+        if check_vma is not None and "check_rep" not in kwargs:
+            kwargs["check_rep"] = check_vma
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kwargs)
+
+    return shard_map
+
+
+def _axis_size(axis_name):
+    """``lax.axis_size`` for jax versions that predate it: the size of a
+    mapped axis is the psum of 1 over it, folded to a Python int at trace
+    time via the axis env (jax.core.get_axis_env / axis_frame)."""
+    from jax import core as jcore
+
+    size = jcore.axis_frame(axis_name)  # returns the size on 0.4.x
+    return getattr(size, "size", size)
+
+
+def install() -> None:
+    """Idempotently install the shims onto the ``jax`` module."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _make_legacy_shard_map()
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size
+    if not hasattr(jax, "enable_x64"):
+        from jax.experimental import enable_x64 as _e64
+        jax.enable_x64 = _e64
+
+
+install()

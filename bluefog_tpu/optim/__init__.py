@@ -23,3 +23,8 @@ from bluefog_tpu.optim.functional import (  # noqa: F401
     rank_major,
     rank_spec_tree,
 )
+from bluefog_tpu.optim.fusion import (  # noqa: F401
+    FusionPlan,
+    plan_groups,
+    size_balanced_threshold,
+)

@@ -40,6 +40,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bluefog_tpu.optim import fusion as _fusion
 from bluefog_tpu.parallel import collectives as C
 from bluefog_tpu.topology.spec import DynamicTopology, Topology
 
@@ -190,6 +191,117 @@ def push_sum_weights(mesh: Mesh, axis_name: str = "bf") -> jax.Array:
                           NamedSharding(mesh, P(axis_name)))
 
 
+def _bucket_groups(leaves, n_buckets: int):
+    """Trace-time size-balanced bucket assignment over per-shard leaves —
+    the SAME grouping walk as the eager wrappers' fusion planner
+    (optim.fusion.plan_groups), thresholded at ceil(total/K) so the
+    buckets are size-balanced.  Dtype boundaries only ever increase the
+    count; leaf granularity bounds it from above (a single dominant
+    leaf — one stacked scan_layers kernel, the embed table — is never
+    split, so such trees get the best bucket count achievable at leaf
+    granularity, possibly < K; see fusion.size_balanced_threshold)."""
+    rows = _fusion.bucket_signature(leaves)
+    threshold = _fusion.size_balanced_threshold(rows, n_buckets)
+    return _fusion.plan_groups(rows, threshold)
+
+
+def _pack_bucket(leaves, group):
+    """Concatenate a bucket's leaves into one flat per-shard buffer (a
+    single-leaf bucket keeps its shape: no reshape traffic, and compress
+    stays per-tensor for it)."""
+    if len(group) == 1:
+        return leaves[group[0]]
+    return jnp.concatenate(
+        [jnp.reshape(leaves[i], (-1,)) for i in group])
+
+
+def _unpack_bucket(buf, leaves, group, outs):
+    """Slice a combined bucket buffer back into ``outs`` at the bucket's
+    leaf indices (shapes/dtypes from the uncombined ``leaves``)."""
+    if len(group) == 1:
+        outs[group[0]] = buf
+        return
+    off = 0
+    for i in group:
+        k = leaves[i].size
+        outs[i] = jnp.reshape(buf[off:off + k], leaves[i].shape)
+        off += k
+
+
+def _bucketed_combine_fn(spec: CommSpec, axis_name: str,
+                         hierarchical_local_size: Optional[int],
+                         compress: Optional[str],
+                         n_buckets: int) -> Callable:
+    """Bucketed combine branch ``fn(tree, key)`` (CTA): the param tree is
+    packed into K size-balanced buckets and each bucket issues its own
+    neighbor combine, in tree order.  Under CTA the forward consumes the
+    combined params bucket-by-bucket (tree order IS layer order for the
+    standard model trees), so forward compute that only needs early
+    buckets is dataflow-independent of late buckets' transfers — exactly
+    the freedom the latency-hiding scheduler needs to overlap them."""
+    wire = compress == "int8_sr"
+    wire_compress = "int8" if wire else compress
+
+    def fn(tree, key):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if not leaves:
+            return tree
+        groups = _bucket_groups(leaves, n_buckets)
+        buffers = [_pack_bucket(leaves, g) for g in groups]
+        combined = C.neighbor_allreduce_buckets(
+            buffers, spec, axis_name, compress=wire_compress,
+            wire_key=key if wire else None,
+            hierarchical_local_size=hierarchical_local_size)
+        outs = [None] * len(leaves)
+        for g, buf in zip(groups, combined):
+            _unpack_bucket(buf, leaves, g, outs)
+        return jax.tree_util.tree_unflatten(treedef, outs)
+
+    return fn
+
+
+def _bucketed_apply_combine_fn(spec: CommSpec, axis_name: str,
+                               hierarchical_local_size: Optional[int],
+                               compress: Optional[str],
+                               n_buckets: int) -> Callable:
+    """Bucketed ATC branch ``fn((params, updates), key) -> params``:
+    bucket *i*'s optax update is applied and its neighbor combine issued
+    BEFORE bucket *i+1*'s update is applied — the jitted counterpart of
+    the reference's per-parameter hooks that enqueue communication while
+    the framework keeps computing (reference optimizers.py:485-841).
+    Bucket *i+1*'s apply arithmetic is dataflow-independent of bucket
+    *i*'s in-flight collective-permute, so the latency-hiding scheduler
+    can place it inside the start->done window."""
+    wire = compress == "int8_sr"
+    wire_compress = "int8" if wire else compress
+
+    def fn(operand, key):
+        params, updates = operand
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        upd_leaves = jax.tree_util.tree_flatten(updates)[0]
+        if not leaves:
+            return params
+        groups = _bucket_groups(leaves, n_buckets)
+        outs = [None] * len(leaves)
+        for bi, g in enumerate(groups):
+            fresh = list(leaves)
+            for i in g:
+                fresh[i] = optax.apply_updates(leaves[i], upd_leaves[i])
+            buf = _pack_bucket(fresh, g)
+            wk = jax.random.fold_in(key, bi) if wire else None
+            if hierarchical_local_size is not None:
+                out = C.hierarchical_neighbor_allreduce(
+                    buf, spec, hierarchical_local_size, axis_name)
+            else:
+                out = C.neighbor_allreduce(
+                    buf, spec, axis_name, compress=wire_compress,
+                    wire_key=wk)
+            _unpack_bucket(out, fresh, g, outs)
+        return jax.tree_util.tree_unflatten(treedef, outs)
+
+    return fn
+
+
 def _combine_fn(spec: CommSpec, axis_name: str,
                 hierarchical_local_size: Optional[int],
                 compress: Optional[str] = None) -> Callable:
@@ -235,6 +347,8 @@ def build_train_step(
     donate: bool = True,
     has_aux: bool = False,
     compress: Optional[str] = None,
+    overlap: str = "none",
+    overlap_buckets: int = 4,
 ) -> Callable:
     """Compile one decentralized SGD/optax step over ``mesh``.
 
@@ -277,6 +391,25 @@ def build_train_step(
     ``compress="bf16"`` rounds the wire payload to bfloat16 (2x less
     traffic for f32 params, self term stays full precision).
 
+    ``overlap="bucketed"`` (cta/atc only) is the overlap engine: the
+    param tree is split into ``overlap_buckets`` size-balanced buckets
+    (same trace-time planner as the eager wrappers' tensor fusion,
+    ``optim.fusion``) and each bucket issues its OWN neighbor combine —
+    for ATC, bucket *i*'s combine launches as soon as its optax update
+    is applied, before bucket *i+1*'s update; for CTA, buckets combine
+    in tree (= layer) order ahead of the forward that consumes them.
+    Every bucket's collective is dataflow-independent of the other
+    buckets' arithmetic, which is the program structure XLA's
+    latency-hiding scheduler needs to run transfers concurrently with
+    compute (the reference gets the same overlap from its background
+    MPI thread + fusion buffers, operations.cc:943-1020); the HLO-level
+    guarantee (>= K collective-permutes — leaf granularity permitting,
+    see ``_bucket_groups`` — with compute scheduled between them) is
+    regression-checked in tests/test_hlo_guarantees.py.
+    Numerics match ``overlap="none"`` exactly except under
+    ``compress="int8*"``, where the absmax scale becomes per-bucket.
+    ``compress=`` and dynamic ``schedule=`` plumb through unchanged.
+
     Returns ``train_step(params, opt_state, batch, step) ->
     (params, opt_state, loss)`` — all rank-major, jit-compiled with
     params/opt_state donated.
@@ -305,13 +438,41 @@ def build_train_step(
                 "compress= is only honored by the flat cta/atc combine "
                 f"(got comm_mode={comm_mode!r}, hierarchical_local_size="
                 f"{hierarchical_local_size!r})")
+    if overlap not in ("none", "bucketed"):
+        raise ValueError(f"unknown overlap mode {overlap!r}")
+    if overlap == "bucketed":
+        if comm_mode not in ("cta", "atc"):
+            raise ValueError(
+                "overlap='bucketed' buckets the cta/atc neighbor combine "
+                f"only (got comm_mode={comm_mode!r}); gradient_allreduce "
+                "relies on XLA's all-reduce combiner and push_sum mixes "
+                "an extended payload that must stay whole")
+        if overlap_buckets < 1:
+            raise ValueError(
+                f"overlap_buckets must be >= 1, got {overlap_buckets}")
+    bucketed = overlap == "bucketed"
+    atc_bucketed = bucketed and comm_mode == "atc"
 
     specs = list(schedule) if schedule is not None else (
         [topology] if topology is not None else [])
-    branches = [
-        _combine_fn(s, axis_name, hierarchical_local_size, compress)
+    if bucketed and comm_mode == "cta":
+        branches = [
+            _bucketed_combine_fn(s, axis_name, hierarchical_local_size,
+                                 compress, overlap_buckets)
+            for s in specs
+        ]
+    elif atc_bucketed:
+        branches = []  # ATC bucketed routes through ac_branches only
+    else:
+        branches = [
+            _combine_fn(s, axis_name, hierarchical_local_size, compress)
+            for s in specs
+        ]
+    ac_branches = [
+        _bucketed_apply_combine_fn(s, axis_name, hierarchical_local_size,
+                                   compress, overlap_buckets)
         for s in specs
-    ]
+    ] if atc_bucketed else []
     ps_branches = [
         (lambda spec: lambda op: C.push_sum_mix(op[0], op[1], spec,
                                                 axis_name))(s)
@@ -366,6 +527,29 @@ def build_train_step(
                             (params, ps))
         return run((params, ps))
 
+    def apply_then_combine(params, updates, step):
+        """ATC overlap engine: the interleaved per-bucket apply+combine
+        (see _bucketed_apply_combine_fn).  Off-cycle steps under
+        num_steps_per_communication still apply the optax update —
+        only the collectives are skipped (lax.cond, like combine())."""
+        if not ac_branches:
+            return optax.apply_updates(params, updates)
+
+        def run(operand):
+            params, updates = operand
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(0x51EED), step)
+            if len(ac_branches) == 1:
+                return ac_branches[0]((params, updates), key)
+            return lax.switch(step % len(ac_branches), ac_branches,
+                              (params, updates), key)
+
+        if k_comm > 1:
+            return lax.cond(step % k_comm == 0, run,
+                            lambda op: optax.apply_updates(op[0], op[1]),
+                            (params, updates))
+        return run((params, updates))
+
     def per_rank_step(params, aux, opt_state, batch, step):
         if has_aux:
             (loss, new_aux), grads = jax.value_and_grad(
@@ -410,9 +594,12 @@ def build_train_step(
         if comm_mode == "cta":
             params = combine(params, step)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        if comm_mode == "atc":
-            params = combine(params, step)
+        if atc_bucketed:
+            params = apply_then_combine(params, updates, step)
+        else:
+            params = optax.apply_updates(params, updates)
+            if comm_mode == "atc":
+                params = combine(params, step)
         return params, new_aux, opt_state, loss
 
     squeeze = lambda t: jax.tree.map(lambda x: x[0], t)

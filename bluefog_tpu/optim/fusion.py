@@ -1,0 +1,169 @@
+"""Shared trace-time bucket/fusion planner.
+
+One grouping policy, two consumers:
+
+* the EAGER optimizer wrappers (``bluefog_tpu.optim.wrappers``) pack
+  parameter leaves into few flat fusion buffers per combine (reference
+  operations.cc:943-1020 + FusionBufferManager tensor_queue.h:75-124),
+  so one eager step issues O(#buffers) collective programs instead of
+  O(#leaves);
+* the JITTED overlap engine (``bluefog_tpu.optim.functional``,
+  ``build_train_step(overlap="bucketed")``) splits the param tree into
+  K size-balanced buckets so the decentralized exchange lowers to K
+  independent collective-permutes the latency-hiding scheduler can
+  interleave with compute, instead of one per leaf clumped at the tail.
+
+Both paths MUST agree on bucket assignments for the same leaf signature
+and threshold (asserted by tests/test_fusion.py): the grouping walk
+lives here and nowhere else.
+
+Grouping policy (identical to the reference's fusion buffer): walk the
+leaves in tree order, packing consecutive same-dtype leaves into the
+current bucket until adding the next leaf would exceed ``threshold``
+bytes; a dtype change always closes the bucket (no silent casting), and
+a leaf larger than the threshold gets a bucket of its own.  Sound for
+any elementwise-linear collective (allreduce / neighbor_allreduce /
+hierarchical): the weighted combine distributes over concatenation.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "plan_groups",
+    "size_balanced_threshold",
+    "leaf_signature",
+    "bucket_signature",
+    "FusionPlan",
+]
+
+# (nbytes, dtype_str) per leaf — the only inputs the grouping walk sees.
+SizeDtype = Tuple[int, str]
+
+
+def plan_groups(sizes_dtypes: Sequence[SizeDtype],
+                threshold: int) -> List[List[int]]:
+    """The ONE grouping walk: consecutive same-dtype leaves pack into a
+    bucket of at most ``threshold`` bytes (an oversize leaf stands
+    alone).  Returns a list of buckets, each a list of leaf indices in
+    order; every index appears exactly once."""
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_dtype = None
+    for i, (nbytes, dtype) in enumerate(sizes_dtypes):
+        nbytes = int(nbytes)
+        if cur and (dtype != cur_dtype or cur_bytes + nbytes > threshold):
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+        cur_dtype = dtype
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def size_balanced_threshold(sizes_dtypes: Sequence[SizeDtype],
+                            n_buckets: int) -> int:
+    """Byte threshold that makes ``plan_groups`` yield ~``n_buckets``
+    size-balanced buckets: ceil(total/K).  Dtype boundaries can only
+    INCREASE the bucket count.  Granularity is the LEAF (the walk never
+    splits one — it must agree with the eager fusion plan), so a single
+    leaf larger than ceil(total/K) absorbs more than its share and the
+    final count can land below K on dominated trees (e.g. one stacked
+    scan_layers kernel holding most of the bytes); the count is then
+    the best achievable at leaf granularity."""
+    if n_buckets <= 0:
+        raise ValueError(f"n_buckets must be positive, got {n_buckets}")
+    total = sum(int(nb) for nb, _ in sizes_dtypes)
+    return max(1, math.ceil(total / n_buckets))
+
+
+def leaf_signature(leaves) -> Tuple[Tuple[Tuple[int, ...], str], ...]:
+    """((shape, dtype_str), ...) — the hashable trace-time identity of a
+    leaf list (works on arrays and on ShapeDtypeStructs)."""
+    return tuple(
+        (tuple(l.shape), str(jnp.asarray(l).dtype
+                             if not hasattr(l, "dtype") else l.dtype))
+        for l in leaves)
+
+
+def bucket_signature(leaves, skip_leading_axis: bool = False):
+    """(nbytes, dtype) rows for ``plan_groups`` from a leaf list.
+
+    ``skip_leading_axis=True`` measures per-rank bytes of rank-major
+    ``[n, ...]`` leaves (the eager wrappers' layout); the jitted path
+    measures the whole per-shard leaf."""
+    rows = []
+    for shape, dtype in leaf_signature(leaves):
+        dims = shape[1:] if skip_leading_axis else shape
+        rows.append((int(np.prod(dims, dtype=np.int64))
+                     * jnp.dtype(dtype).itemsize, dtype))
+    return rows
+
+
+class FusionPlan:
+    """Rank-major tensor fusion for the eager path: same-dtype parameter
+    leaves are packed, in order, into flat ``[n, K]`` buffers of at most
+    ``threshold`` bytes per rank, so one combine issues O(#buffers)
+    collective programs instead of O(#leaves) — ~160 leaves of ResNet-50
+    become 2-3 dispatches (reference operations.cc:943-1020).
+
+    ``pack`` and ``unpack`` are each ONE jitted program, cached per leaf
+    signature (module-level, bounded by the distinct model shapes in the
+    process).
+    """
+
+    _cache: Dict[Any, "FusionPlan"] = {}
+
+    def __init__(self, signature, threshold: int):
+        self.signature = signature  # tuple of ((n, ...) shape, dtype str)
+        rows = [
+            (int(np.prod(shape[1:], dtype=np.int64))
+             * jnp.dtype(dtype).itemsize, dtype)
+            for shape, dtype in signature
+        ]
+        groups = plan_groups(rows, threshold)
+        self.groups = groups
+
+        def pack(leaves):
+            n = leaves[0].shape[0]
+            return tuple(
+                jnp.concatenate(
+                    [jnp.reshape(leaves[i], (n, -1)) for i in g], axis=1)
+                if len(g) > 1 else leaves[g[0]]
+                for g in groups)
+
+        def unpack(buffers):
+            outs = [None] * len(signature)
+            for g, buf in zip(groups, buffers):
+                if len(g) == 1:
+                    outs[g[0]] = buf
+                    continue
+                off = 0
+                for i in g:
+                    shape = signature[i][0]
+                    k = int(np.prod(shape[1:]))
+                    outs[i] = jnp.reshape(buf[:, off:off + k], shape)
+                    off += k
+            return tuple(outs)
+
+        self.pack = jax.jit(pack)
+        self.unpack = jax.jit(unpack)
+
+    @classmethod
+    def for_leaves(cls, leaves, threshold: int) -> "FusionPlan":
+        signature = leaf_signature(leaves)
+        key = (signature, threshold)
+        plan = cls._cache.get(key)
+        if plan is None:
+            plan = cls(signature, threshold)
+            cls._cache[key] = plan
+        return plan

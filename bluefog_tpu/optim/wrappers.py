@@ -46,6 +46,7 @@ import optax
 from bluefog_tpu import api
 from bluefog_tpu import config as bfconfig
 from bluefog_tpu.context import get_context
+from bluefog_tpu.optim.fusion import FusionPlan
 
 __all__ = [
     "CommunicationType",
@@ -75,76 +76,11 @@ class _OptState(NamedTuple):
     step: jnp.ndarray  # scalar int32
 
 
-class _FusionPlan:
-    """Tensor fusion for the eager path (reference operations.cc:943-1020 +
-    FusionBufferManager tensor_queue.h:75-124): same-dtype parameter leaves
-    are packed, in order, into flat ``[n, K]`` buffers of at most
-    ``threshold`` bytes per rank, so one combine issues O(#buffers)
-    collective programs instead of O(#leaves) — ~160 leaves of ResNet-50
-    become 2-3 dispatches.  Sound for any elementwise-linear collective
-    (allreduce / neighbor_allreduce / hierarchical): the weighted combine
-    distributes over concatenation.
-
-    ``pack`` and ``unpack`` are each ONE jitted program, cached per leaf
-    signature (module-level, bounded by the distinct model shapes in the
-    process).
-    """
-
-    _cache: Dict[Any, "_FusionPlan"] = {}
-
-    def __init__(self, signature, threshold: int):
-        self.signature = signature  # tuple of ((n, ...) shape, dtype str)
-        groups = []  # list of lists of leaf indices
-        cur, cur_bytes = [], 0
-        cur_dtype = None
-        for i, (shape, dtype) in enumerate(signature):
-            per_rank = int(np.prod(shape[1:])) * jnp.dtype(dtype).itemsize
-            if cur and (dtype != cur_dtype
-                        or cur_bytes + per_rank > threshold):
-                groups.append(cur)
-                cur, cur_bytes = [], 0
-            cur.append(i)
-            cur_bytes += per_rank
-            cur_dtype = dtype
-        if cur:
-            groups.append(cur)
-        self.groups = groups
-
-        def pack(leaves):
-            n = leaves[0].shape[0]
-            return tuple(
-                jnp.concatenate(
-                    [jnp.reshape(leaves[i], (n, -1)) for i in g], axis=1)
-                if len(g) > 1 else leaves[g[0]]
-                for g in groups)
-
-        def unpack(buffers):
-            outs = [None] * len(signature)
-            for g, buf in zip(groups, buffers):
-                if len(g) == 1:
-                    outs[g[0]] = buf
-                    continue
-                off = 0
-                for i in g:
-                    shape = signature[i][0]
-                    k = int(np.prod(shape[1:]))
-                    outs[i] = jnp.reshape(buf[:, off:off + k], shape)
-                    off += k
-            return tuple(outs)
-
-        self.pack = jax.jit(pack)
-        self.unpack = jax.jit(unpack)
-
-    @classmethod
-    def for_leaves(cls, leaves, threshold: int) -> "_FusionPlan":
-        signature = tuple(
-            (tuple(l.shape), str(jnp.asarray(l).dtype)) for l in leaves)
-        key = (signature, threshold)
-        plan = cls._cache.get(key)
-        if plan is None:
-            plan = cls(signature, threshold)
-            cls._cache[key] = plan
-        return plan
+# The fusion planner (grouping walk + rank-major pack/unpack) now lives in
+# the shared trace-time module so the jitted overlap engine
+# (functional.build_train_step(overlap="bucketed")) and this eager path
+# provably use ONE grouping policy (tests/test_fusion.py).
+_FusionPlan = FusionPlan
 
 
 def _tree_names(params) -> Dict[str, Any]:

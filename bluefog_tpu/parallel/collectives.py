@@ -45,6 +45,7 @@ __all__ = [
     "allgather",
     "allgatherv",
     "neighbor_allreduce",
+    "neighbor_allreduce_buckets",
     "neighbor_allgather",
     "edge_structure",
     "class_recv_weights",
@@ -324,6 +325,48 @@ def neighbor_allreduce(
     for r, w in zip(received, weights[1:]):
         acc = acc + r.astype(acc_dtype) * w
     return acc.astype(x.dtype)
+
+
+def neighbor_allreduce_buckets(
+    buffers: Sequence[jax.Array],
+    spec: CommSpec,
+    axis_name: str,
+    compress: Optional[str] = None,
+    wire_key: Optional[jax.Array] = None,
+    hierarchical_local_size: Optional[int] = None,
+) -> list:
+    """One weighted neighbor combine per bucket buffer — the data plane
+    of the jitted overlap engine (``build_train_step(overlap=
+    "bucketed")``).
+
+    Each bucket is an INDEPENDENT collective over the same topology: on
+    an async backend every bucket lowers to its own
+    ``collective-permute-start``/``-done`` pair, so XLA's latency-hiding
+    scheduler can run bucket *i*'s transfer concurrently with whatever
+    arithmetic bucket *i+1* (or the surrounding step) has ready — the
+    TPU-native equivalent of the reference's background-thread overlap
+    (reference optimizers.py hooks + operations.cc tensor fusion), with
+    the schedule decided by the compiler instead of a host thread.
+
+    ``wire_key`` (with ``compress="int8"``) is folded with the BUCKET
+    index so every bucket draws independent stochastic-rounding noise;
+    ``hierarchical_local_size`` routes buckets through the machine-level
+    combine instead.  Numerics per element are identical to the per-leaf
+    ``neighbor_allreduce`` (the weighted combine distributes over
+    concatenation) except for int8's per-TENSOR absmax scale, which under
+    bucketing is per-BUCKET.
+    """
+    outs = []
+    for i, buf in enumerate(buffers):
+        if hierarchical_local_size is not None:
+            outs.append(hierarchical_neighbor_allreduce(
+                buf, spec, hierarchical_local_size, axis_name))
+            continue
+        key = (jax.random.fold_in(wire_key, i)
+               if wire_key is not None else None)
+        outs.append(neighbor_allreduce(
+            buf, spec, axis_name, compress=compress, wire_key=key))
+    return outs
 
 
 def neighbor_allgather(

@@ -35,7 +35,30 @@ import jax.numpy as jnp
 
 from bluefog_tpu.parallel.pallas_attention import _fit_block
 
-__all__ = ["splash_attention"]
+__all__ = ["splash_attention", "library_supports_head_dim"]
+
+
+@functools.lru_cache(maxsize=8)
+def library_supports_head_dim(d: int) -> bool:
+    """Whether the INSTALLED splash library kernel accepts ``head_dim=d``.
+
+    Older jax releases hard-require head_dim to be a whole 128-lane
+    multiple; newer ones pad narrower heads internally.  Probed by
+    abstractly tracing a tiny call (no compute), so callers and tests
+    can gate instead of tripping the library's NotImplementedError deep
+    inside a model trace."""
+    if d % 128 == 0:
+        return True
+    try:
+        with jax.enable_x64(False):
+            q = jax.ShapeDtypeStruct((1, 128, 1, d), jnp.float32)
+            jax.eval_shape(
+                lambda a, b, c: splash_attention(
+                    a, b, c, block_q=128, block_kv=128, interpret=True),
+                q, q, q)
+        return True
+    except NotImplementedError:
+        return False
 
 
 def _auto_interpret(interpret: Optional[bool]) -> bool:
@@ -53,7 +76,13 @@ def _make_kernel(n_heads: int, seq: int, block_q: int, block_kv: int,
 
     mask = sa.MultiHeadMask([sa.CausalMask((seq, seq))
                              for _ in range(n_heads)])
-    bq = _fit_block(seq, block_q)
+    # q blocks must be whole 8-row sublane tiles (the library kernel's
+    # grid math otherwise fails deep inside Mosaic with an opaque
+    # layout error); seq is a multiple of 128 here (checked by the
+    # wrapper), so fitting over seq//8 then scaling back up keeps every
+    # candidate divisor tile-aligned — the same construction bkv uses
+    # for whole 128-lane tiles below.
+    bq = _fit_block(seq // 8, max(block_q // 8, 1)) * 8
     # kv blocks must be whole 128-lane tiles (kernel NUM_LANES check)
     bkv = _fit_block(seq // 128, max(block_kv // 128, 1)) * 128
     sizes = sa.BlockSizes(

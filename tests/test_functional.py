@@ -241,6 +241,124 @@ def test_push_sum_pure_mix_reaches_uniform_average():
                                atol=1e-5)
 
 
+def _multi_leaf_problem(seed=0):
+    """Several leaves of mixed sizes so bucketing has real work."""
+    rng = np.random.RandomState(seed)
+    base = {"w1": jnp.asarray(rng.randn(DIM, 8) * 0.3),
+            "b1": jnp.zeros((8,)),
+            "w2": jnp.asarray(rng.randn(8, 1) * 0.3),
+            "b2": jnp.zeros((1,))}
+
+    def loss_fn(params, batch):
+        A, b = batch
+        h = jnp.tanh(A @ params["w1"] + params["b1"])
+        pred = (h @ params["w2"] + params["b2"])[..., 0]
+        return jnp.mean((pred - b) ** 2)
+
+    return base, loss_fn
+
+
+@pytest.mark.parametrize("comm_mode", ["cta", "atc"])
+def test_bucketed_overlap_numerical_parity(comm_mode):
+    """overlap='bucketed' computes the SAME training trajectory as the
+    non-overlapped step (acceptance: same params/loss to f32
+    tolerance) — the weighted combine distributes over concatenation,
+    so bucketing is a schedule change, not a math change."""
+    mesh = _mesh()
+    base, loss_fn = _multi_leaf_problem()
+    opt = optax.sgd(0.05)
+    spec = _topology_spec()
+    plain = F.build_train_step(
+        loss_fn, opt, mesh, comm_mode=comm_mode, topology=spec,
+        donate=False)
+    bucketed = F.build_train_step(
+        loss_fn, opt, mesh, comm_mode=comm_mode, topology=spec,
+        donate=False, overlap="bucketed", overlap_buckets=3)
+    As, bs, _ = _linear_problem()
+    bs = bs[..., 0] * 0 + bs.mean(-1)
+    batch = (jax.device_put(As, NamedSharding(mesh, P("bf"))),
+             jax.device_put(bs, NamedSharding(mesh, P("bf"))))
+    pA = pB = F.rank_major(base, mesh)
+    oA = oB = F.rank_major(opt.init(base), mesh)
+    for i in range(8):
+        pA, oA, lA = plain(pA, oA, batch, jnp.int32(i))
+        pB, oB, lB = bucketed(pB, oB, batch, jnp.int32(i))
+    np.testing.assert_allclose(np.asarray(lA, np.float32),
+                               np.asarray(lB, np.float32), rtol=1e-6)
+    for k in base:
+        np.testing.assert_allclose(
+            np.asarray(pA[k], np.float32), np.asarray(pB[k], np.float32),
+            rtol=1e-6, atol=1e-7, err_msg=f"leaf {k}")
+
+
+def test_bucketed_dynamic_schedule_consensus():
+    """Bucketed combine through the lax.switch dynamic schedule: lr=0
+    one-peer averaging still reaches exact consensus (the plumbing the
+    overlap engine must not disturb)."""
+    mesh = _mesh()
+    rounds = int(np.log2(N))
+    schedule = one_peer_dynamic_schedule(N)
+    step_fn = F.build_train_step(
+        loss_fn, optax.sgd(0.0), mesh, comm_mode="cta",
+        schedule=schedule, overlap="bucketed", overlap_buckets=2)
+    As, bs, _ = _linear_problem()
+    params = {"x": jax.device_put(
+        np.arange(N * DIM, dtype=np.float64).reshape(N, DIM),
+        NamedSharding(mesh, P("bf")))}
+    opt_state = F.rank_major(optax.sgd(0.0).init({"x": jnp.zeros(DIM)}),
+                             mesh)
+    batch = (jax.device_put(As, NamedSharding(mesh, P("bf"))),
+             jax.device_put(bs, NamedSharding(mesh, P("bf"))))
+    for i in range(6 * rounds):
+        params, opt_state, _ = step_fn(params, opt_state, batch,
+                                       jnp.int32(i))
+    assert float(F.consensus_distance(params)) < 1e-10
+
+
+def test_bucketed_periodic_communication_still_applies_updates():
+    """ATC bucketed + num_steps_per_communication=2: off-cycle steps
+    skip the collectives but MUST still apply the optax update."""
+    mesh = _mesh()
+    base, loss_fn_ml = _multi_leaf_problem()
+    opt = optax.sgd(0.05)
+    step_fn = F.build_train_step(
+        loss_fn_ml, opt, mesh, comm_mode="atc",
+        topology=_topology_spec(), num_steps_per_communication=2,
+        overlap="bucketed", overlap_buckets=2)
+    As, bs, _ = _linear_problem()
+    bs = bs.mean(-1)
+    params = F.rank_major(base, mesh)
+    opt_state = F.rank_major(opt.init(base), mesh)
+    batch = (jax.device_put(As, NamedSharding(mesh, P("bf"))),
+             jax.device_put(bs, NamedSharding(mesh, P("bf"))))
+    before = np.asarray(params["w1"])
+    # odd step: no communication, but the update must land
+    params, opt_state, _ = step_fn(params, opt_state, batch, jnp.int32(1))
+    assert np.abs(np.asarray(params["w1"]) - before).max() > 0
+
+
+def test_bucketed_overlap_mode_validation():
+    """Unsupported overlap combos are rejected up front."""
+    mesh = _mesh()
+    spec = _topology_spec()
+    with pytest.raises(ValueError, match="overlap"):
+        F.build_train_step(loss_fn, optax.sgd(0.1), mesh,
+                           comm_mode="cta", topology=spec,
+                           overlap="bogus")
+    with pytest.raises(ValueError, match="bucketed"):
+        F.build_train_step(loss_fn, optax.sgd(0.1), mesh,
+                           comm_mode="gradient_allreduce",
+                           overlap="bucketed")
+    with pytest.raises(ValueError, match="bucketed"):
+        F.build_train_step(loss_fn, optax.sgd(0.1), mesh,
+                           comm_mode="push_sum", topology=spec,
+                           overlap="bucketed")
+    with pytest.raises(ValueError, match="overlap_buckets"):
+        F.build_train_step(loss_fn, optax.sgd(0.1), mesh,
+                           comm_mode="cta", topology=spec,
+                           overlap="bucketed", overlap_buckets=0)
+
+
 def test_push_sum_non_doubly_stochastic_graph():
     """Regression: a directed ring PLUS one extra edge (out-degrees 2,1,...)
     is strongly connected but NOT doubly stochastic — mixing the de-biased

@@ -135,3 +135,65 @@ def test_fusion_plan_cache_bounded(bf_ctx):
     p1 = _FusionPlan.for_leaves(leaves, 8 << 20)
     p2 = _FusionPlan.for_leaves(leaves, 8 << 20)
     assert p1 is p2
+
+
+# --- shared planner: eager fusion and the jitted overlap engine must ---
+# --- produce IDENTICAL bucket assignments (optim/fusion.py)          ---
+
+def test_shared_planner_identity_with_eager_plan():
+    """The eager _FusionPlan's groups == plan_groups over the same
+    per-rank leaf signature and threshold — one grouping policy for
+    both the eager fusion buffers and the jitted bucketed combine."""
+    from bluefog_tpu.optim import fusion
+
+    params = many_leaf_params(n_leaves=23, seed=5)
+    leaves = list(params.values())
+    for threshold in (64, 640, 8 << 20):
+        plan = _FusionPlan.for_leaves(leaves, threshold)
+        rows = fusion.bucket_signature(leaves, skip_leading_axis=True)
+        assert fusion.plan_groups(rows, threshold) == plan.groups
+
+
+def test_shared_planner_matches_jitted_bucket_groups():
+    """The bucketed train step's trace-time bucket assignment is the
+    shared walk at the size-balanced threshold (functional._bucket_groups
+    delegates to fusion.plan_groups)."""
+    from bluefog_tpu.optim import fusion
+    from bluefog_tpu.optim.functional import _bucket_groups
+
+    leaves = [jnp.zeros((32, 16), jnp.float32) for _ in range(10)]
+    rows = fusion.bucket_signature(leaves)
+    k = 4
+    expect = fusion.plan_groups(
+        rows, fusion.size_balanced_threshold(rows, k))
+    assert _bucket_groups(leaves, k) == expect
+    assert len(expect) >= k  # size-balanced floor
+    # every leaf appears exactly once, in order
+    flat = [i for g in expect for i in g]
+    assert flat == list(range(len(leaves)))
+
+
+def test_planner_dtype_boundary_closes_bucket():
+    """A dtype change ALWAYS closes the open bucket (no silent casting),
+    in both consumers of the shared walk."""
+    from bluefog_tpu.optim import fusion
+
+    rows = [(100, "float32"), (100, "float32"), (100, "int32"),
+            (100, "int32"), (100, "float32")]
+    groups = fusion.plan_groups(rows, 1 << 20)
+    assert groups == [[0, 1], [2, 3], [4]]
+
+
+def test_planner_oversize_leaf_stands_alone():
+    """A leaf larger than the threshold gets its own bucket; neighbors
+    never ride along with it."""
+    from bluefog_tpu.optim import fusion
+
+    rows = [(100, "float32"), (100, "float32"), (1000, "float32"),
+            (50, "float32"), (50, "float32")]
+    groups = fusion.plan_groups(rows, 250)
+    assert groups == [[0, 1], [2], [3, 4]]
+    # and the size-balanced threshold keeps >= K buckets despite it
+    k = 3
+    t = fusion.size_balanced_threshold(rows, k)
+    assert len(fusion.plan_groups(rows, t)) >= k

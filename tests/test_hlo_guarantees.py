@@ -186,6 +186,221 @@ def test_allreduce_baseline_uses_no_permute_but_psum(mesh):
     assert "all-reduce" in hlo
 
 
+# --- overlap engine: bucketed exchange, verified at the schedule level ---
+
+def _overlap_problem():
+    """Multi-leaf model (4 matmul kernels + 4 biases) so bucketing has
+    something to balance; leaf dtypes are uniform f32."""
+    base = {f"w{i}": jnp.eye(16) * 0.5 for i in range(4)}
+    base.update({f"b{i}": jnp.zeros((16,)) for i in range(4)})
+
+    def loss_fn(params, batch):
+        h = batch
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"] + params[f"b{i}"])
+        return jnp.mean((h - 1.0) ** 2)
+
+    return base, loss_fn
+
+
+def _lower_step(mesh, base, loss_fn, **kw):
+    import optax as ox
+
+    opt = ox.sgd(0.05)
+    step = F.build_train_step(loss_fn, opt, mesh, donate=False, **kw)
+    params = F.rank_major(base, mesh)
+    ostate = F.rank_major(opt.init(base), mesh)
+    batch = jax.device_put(
+        np.zeros((N, 8, 16)), NamedSharding(mesh, P("bf")))
+    return step.lower(params, ostate, batch,
+                      jnp.int32(0)).compile().as_text()
+
+
+def _permute_gap_flops(hlo):
+    """Flops scheduled between consecutive collective-permutes, per
+    computation holding >= 2 of them — the machine-checkable interleave
+    property on sync lowerings (async lowerings are checked through
+    their start->done windows instead)."""
+    from bluefog_tpu import benchutil as BU
+
+    comps = BU._parse_computations(hlo)
+    memo: dict = {}
+    gaps = []
+    for instrs in comps.values():
+        idxs = [i["idx"] for i in instrs
+                if i["op"].startswith("collective-permute")]
+        for a, b in zip(idxs, idxs[1:]):
+            gaps.append(sum(BU._instr_flops(instrs[k], comps, memo)
+                            for k in range(a + 1, b)))
+    return gaps
+
+
+@pytest.mark.parametrize("comm_mode", ["cta", "atc"])
+def test_bucketed_step_k_exchanges_with_compute_between(mesh, comm_mode):
+    """build_train_step(overlap='bucketed', K) lowers to >= K
+    collective-permutes — one per size-balanced bucket, NOT one
+    monolithic tail exchange and NOT one per leaf — and the scheduled
+    program carries non-trivial compute inside each exchange's window:
+    start->done on async lowerings, between consecutive issues on this
+    sync (CPU) lowering.  Every bucket exchange also has nonzero
+    dataflow-INDEPENDENT compute — the admissible set the TPU
+    latency-hiding scheduler draws from."""
+    from bluefog_tpu import benchutil as BU
+    from bluefog_tpu.optim import fusion
+
+    K = 4
+    base, loss_fn = _overlap_problem()
+    spec = one_peer_dynamic_schedule(N)[0]  # single shift class
+    hlo = _lower_step(mesh, base, loss_fn, comm_mode=comm_mode,
+                      topology=spec, overlap="bucketed",
+                      overlap_buckets=K)
+    assert _count_permutes(hlo) >= K
+
+    wins = [w for w in BU.scheduled_collective_windows(hlo)
+            if w["kind"] == "collective-permute"]
+    assert len(wins) >= K
+    # size-balanced: no bucket exceeds the planner's ceil(total/K)
+    # threshold (uniform dtype, no oversize leaf in this tree)
+    rows = fusion.bucket_signature(list(
+        jax.tree_util.tree_flatten(base)[0]))
+    threshold = fusion.size_balanced_threshold(rows, K)
+    assert all(w["bytes"] <= threshold for w in wins)
+    # the latency-hiding scheduler's admissible set is non-empty for
+    # EVERY bucket: compute independent of that bucket's exchange
+    assert all(w["independent_flops"] > 0 for w in wins)
+    if any(w["async"] for w in wins):
+        # async lowering (TPU): compute scheduled INSIDE each window
+        assert all(w["window_flops"] > 0 for w in wins if w["async"])
+    else:
+        # sync lowering (CPU): the schedule still interleaves — real
+        # compute sits between every pair of consecutive exchanges
+        gaps = _permute_gap_flops(hlo)
+        assert gaps and all(g > 0 for g in gaps)
+
+
+def test_unbucketed_step_is_per_leaf_tail_exchange(mesh):
+    """Contrast pin: overlap='none' issues one permute PER LEAF with
+    unbalanced payloads — the structure the bucketed mode replaces."""
+    from bluefog_tpu import benchutil as BU
+
+    base, loss_fn = _overlap_problem()
+    spec = one_peer_dynamic_schedule(N)[0]
+    hlo = _lower_step(mesh, base, loss_fn, comm_mode="atc",
+                      topology=spec)
+    wins = [w for w in BU.scheduled_collective_windows(hlo)
+            if w["kind"] == "collective-permute"]
+    assert len(wins) == len(jax.tree_util.tree_leaves(base))
+    sizes = sorted(w["bytes"] for w in wins)
+    assert sizes[-1] > 4 * sizes[0]  # biases vs kernels: unbalanced
+
+
+def test_bucketed_dynamic_schedule_total_permutes(mesh):
+    """The bucketed combine plumbs through the lax.switch dynamic
+    schedule: the one compiled program holds >= K permutes per branch
+    (one branch executes per step), under a conditional."""
+    K = 3
+    base, loss_fn = _overlap_problem()
+    schedule = one_peer_dynamic_schedule(N)
+    hlo = _lower_step(mesh, base, loss_fn, comm_mode="atc",
+                      schedule=schedule, overlap="bucketed",
+                      overlap_buckets=K)
+    assert _count_permutes(hlo) >= K * len(schedule)
+    assert "conditional" in hlo
+
+
+_ASYNC_FIXTURE = """\
+HloModule overlap_fixture, is_scheduled=true
+
+ENTRY %main (p0: f32[1024,256], p1: f32[256,256]) -> f32[1024,256] {
+  %p0 = f32[1024,256]{1,0} parameter(0)
+  %p1 = f32[256,256]{1,0} parameter(1)
+  %cps = (f32[1024,256]{1,0}, f32[1024,256]{1,0}) collective-permute-start(f32[1024,256]{1,0} %p0), source_target_pairs={{0,1},{1,0}}
+  %hide = f32[1024,256]{1,0} dot(f32[1024,256]{1,0} %p0, f32[256,256]{1,0} %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %cpd = f32[1024,256]{1,0} collective-permute-done((f32[1024,256]{1,0}, f32[1024,256]{1,0}) %cps)
+  %cps.2 = (f32[1024,256]{1,0}, f32[1024,256]{1,0}) collective-permute-start(f32[1024,256]{1,0} %hide), source_target_pairs={{0,1},{1,0}}
+  %cpd.2 = f32[1024,256]{1,0} collective-permute-done((f32[1024,256]{1,0}, f32[1024,256]{1,0}) %cps.2)
+  ROOT %out = f32[1024,256]{1,0} add(f32[1024,256]{1,0} %cpd, f32[1024,256]{1,0} %cpd.2)
+}
+"""
+
+
+def test_overlap_accounting_async_windows():
+    """The scheduled-window accounting on a TPU-style async module: the
+    first permute's start->done window holds a dot (overlappable at a
+    threshold its flops clear), the second's window is empty (never
+    overlappable) -> byte-weighted fraction 0.5, basis 'scheduled'."""
+    from bluefog_tpu.benchutil import (overlap_accounting,
+                                       scheduled_collective_windows)
+
+    wins = scheduled_collective_windows(_ASYNC_FIXTURE)
+    assert [w["async"] for w in wins] == [True, True]
+    payload = 1024 * 256 * 4
+    assert [w["bytes"] for w in wins] == [payload, payload]
+    dot_flops = 2 * 1024 * 256 * 256
+    assert wins[0]["window_flops"] == dot_flops
+    assert wins[1]["window_flops"] == 0.0
+    # threshold the dot clears: transfer = payload/link; flops/peak must
+    # exceed it.  peak=1e12, link=1e9 -> hide 1.34e-4 s >= 1.05e-3 s?
+    # no — pick link so transfer is smaller: link=1e10 -> 1.05e-4 s.
+    acc = overlap_accounting(_ASYNC_FIXTURE, peak_flops_per_s=1e12,
+                             link_bytes_per_s=1e10)
+    assert acc["basis"] == "scheduled"
+    assert acc["bytes_total"] == 2 * payload
+    assert acc["bytes_overlappable"] == payload
+    assert acc["fraction"] == 0.5
+    # an impossible link speed makes nothing overlappable
+    slow = overlap_accounting(_ASYNC_FIXTURE, peak_flops_per_s=1e12,
+                              link_bytes_per_s=1e6)
+    assert slow["fraction"] == 0.0
+
+
+def test_overlap_accounting_dataflow_basis_on_real_step(mesh):
+    """On this CPU lowering (sync permutes) the accounting falls back to
+    the dataflow basis and, with generous hardware figures, finds every
+    bucket hideable; with an absurdly slow link, none."""
+    from bluefog_tpu.benchutil import overlap_accounting
+
+    base, loss_fn = _overlap_problem()
+    spec = one_peer_dynamic_schedule(N)[0]
+    hlo = _lower_step(mesh, base, loss_fn, comm_mode="atc",
+                      topology=spec, overlap="bucketed",
+                      overlap_buckets=4)
+    acc = overlap_accounting(hlo, peak_flops_per_s=1e6,
+                             link_bytes_per_s=1e12)
+    assert acc["basis"] == "dataflow"
+    assert sum(r["count"] for r in acc["per_kind"].values()) >= 4
+    assert acc["fraction"] == 1.0
+    none = overlap_accounting(hlo, peak_flops_per_s=1e15,
+                              link_bytes_per_s=1.0)
+    assert none["fraction"] == 0.0
+
+
+@pytest.mark.slow
+def test_8b_overlap_audit_end_to_end(tmp_path):
+    """The full 8B overlap audit (benchmarks/llama_8b_overlap.py): AOT
+    compile of the bucketed tp8_seqshard step + accounting + defended
+    projection.  Minutes of compile — excluded from tier-1 by the slow
+    marker; the fast schedule checks above cover the engine."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "r06.json"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "benchmarks", "llama_8b_overlap.py"),
+         "--out", str(out)], check=True, env=env, cwd=repo)
+    import json
+
+    got = json.loads(out.read_text())
+    assert 0.0 <= got["overlap"]["dp_neighbor_exchange"]["fraction"] <= 1.0
+    assert got["overlap"]["buckets"] >= 1
+
+
 def test_hlo_collective_bytes_extraction(mesh):
     """The scaling-projection harness's byte extractor
     (benchutil.hlo_collective_bytes) reads per-device payloads out of
