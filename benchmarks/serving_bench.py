@@ -1,0 +1,225 @@
+"""Continuous batching vs one-shot static batching under Poisson load.
+
+The experiment the serving engine exists for: synthetic requests arrive
+as a seeded Poisson process (``benchutil.poisson_arrivals`` — the same
+trace generator the tests replay), with per-request prompt lengths and
+token budgets drawn from seeded ranges.  Two servers handle the same
+trace on the CPU mesh:
+
+* **continuous** — the slot-pooled engine: admit on arrival, chunked
+  prefill rides between decode steps, slots retire and readmit.
+* **static** — what one-shot ``llama_generate`` forces: fixed batch
+  shape (capacity x global max prompt x global max budget — a static
+  server compiles ONE program), a batch launches only after ALL its
+  requests have arrived and the previous batch finished, and nobody
+  streams: a request's first token is observable at batch completion.
+
+Reported per side: aggregate USEFUL tokens/s (requested tokens only —
+the static server's padding rows and over-generated tail are waste, not
+throughput) and TTFT/latency p50/p99.  Writes ``serving_bench_r07.json``
+(repo root) by default.
+
+  JAX_PLATFORMS=cpu python benchmarks/serving_bench.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bluefog_tpu import models
+from bluefog_tpu.benchutil import poisson_arrivals
+from bluefog_tpu.models import llama_generate
+from bluefog_tpu.serving import (Request, ServingEngine, ServingMetrics,
+                                 percentile)
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--num-requests", type=int, default=40)
+parser.add_argument("--rate", type=float, default=60.0,
+                    help="Poisson arrival rate, requests/s (the default "
+                    "keeps both servers saturated with visible queueing "
+                    "at the default model size)")
+parser.add_argument("--capacity", type=int, default=6)
+parser.add_argument("--max-len", type=int, default=96)
+parser.add_argument("--prefill-chunk", type=int, default=24)
+parser.add_argument("--decode-horizon", type=int, default=8,
+                    help="tokens per host iteration (throughput mode; "
+                    "the emitted streams are horizon-invariant)")
+parser.add_argument("--prefill-budget", type=int, default=6,
+                    help="prefill chunks per engine step (admission "
+                    "must keep the pool full in throughput mode)")
+parser.add_argument("--prompt-len", type=int, nargs=2, default=(2, 40),
+                    metavar=("MIN", "MAX"))
+parser.add_argument("--new-tokens", type=int, nargs=2, default=(2, 48),
+                    metavar=("MIN", "MAX"),
+                    help="wide generation-length variance is the regime "
+                    "continuous batching targets: a static batch runs "
+                    "every row to the batch max")
+parser.add_argument("--seed", type=int, default=0)
+parser.add_argument("--dim", type=int, default=256,
+                    help="model width (dispatch overhead must not "
+                    "dominate a per-token decode step, or the bench "
+                    "measures the host loop, not batching policy)")
+parser.add_argument("--layers", type=int, default=6)
+parser.add_argument("--out", default="serving_bench_r07.json")
+
+
+def make_trace(args):
+    rs = np.random.RandomState(args.seed + 1)
+    arrivals = poisson_arrivals(args.rate, args.num_requests, args.seed)
+    lens = rs.randint(args.prompt_len[0], args.prompt_len[1] + 1,
+                      args.num_requests)
+    budgets = rs.randint(args.new_tokens[0], args.new_tokens[1] + 1,
+                         args.num_requests)
+    prompts = [rs.randint(0, 256, (n,)).astype(np.int32) for n in lens]
+    return arrivals, prompts, budgets
+
+
+def run_continuous(variables, cfg, args, arrivals, prompts, budgets):
+    eng = ServingEngine(variables, cfg, capacity=args.capacity,
+                        max_len=args.max_len,
+                        prefill_chunk=args.prefill_chunk,
+                        decode_horizon=args.decode_horizon,
+                        prefill_budget=args.prefill_budget,
+                        max_queue=args.num_requests)
+    # warm the resident programs outside the timed window (a server
+    # compiles once at deploy, not per request)
+    warm = eng.submit(Request(prompts[0], 2))
+    eng.run()
+    assert warm.done
+    eng.metrics = ServingMetrics()  # occupancy/queue gauges start clean
+
+    reqs = [Request(p, int(b)) for p, b in zip(prompts, budgets)]
+    submit_t, first_t, finish_t = {}, {}, {}
+    pending = list(range(len(reqs)))
+    t0 = time.monotonic()
+    while True:
+        now = time.monotonic() - t0
+        while pending and arrivals[pending[0]] <= now:
+            i = pending.pop(0)
+            eng.submit(reqs[i])
+            submit_t[i] = time.monotonic() - t0
+        busy = eng.step()
+        now = time.monotonic() - t0
+        for i, r in enumerate(reqs):
+            if i not in first_t and r.tokens:
+                first_t[i] = now
+            if i not in finish_t and r.done:
+                finish_t[i] = now
+        if not busy:
+            if not pending:
+                break
+            time.sleep(max(0.0, arrivals[pending[0]] - now))
+    makespan = max(finish_t.values())
+    useful = sum(len(r.tokens) for r in reqs)
+    m = eng.metrics.summary()
+    return {
+        "tokens_per_sec": useful / makespan,
+        "useful_tokens": int(useful),
+        "makespan_s": makespan,
+        "ttft_p50": percentile([first_t[i] - arrivals[i]
+                                for i in first_t], 50),
+        "ttft_p99": percentile([first_t[i] - arrivals[i]
+                                for i in first_t], 99),
+        "latency_p50": percentile([finish_t[i] - arrivals[i]
+                                   for i in finish_t], 50),
+        "latency_p99": percentile([finish_t[i] - arrivals[i]
+                                   for i in finish_t], 99),
+        "mean_slot_occupancy": m["mean_slot_occupancy"],
+        "max_queue_depth": m["max_queue_depth"],
+    }
+
+
+def run_static(variables, cfg, args, arrivals, prompts, budgets):
+    """One-shot llama_generate as a server: ONE compiled shape
+    (capacity x max prompt x max budget), batches in arrival order, each
+    gated on its slowest arrival and the previous batch's completion."""
+    cap = args.capacity
+    max_prompt = max(p.size for p in prompts)
+    max_budget = int(max(budgets))
+
+    def gen(batch_prompts):
+        padded = np.zeros((cap, max_prompt), np.int32)
+        for j, p in enumerate(batch_prompts):
+            padded[j, :p.size] = p
+        out = llama_generate(variables, cfg, jnp.asarray(padded),
+                             max_budget, max_len=args.max_len)
+        return np.asarray(out)  # block: the batch is done when fetched
+
+    gen([prompts[0]])  # compile outside the timed window
+
+    n = len(prompts)
+    batches = [list(range(i, min(i + cap, n))) for i in range(0, n, cap)]
+    ttft, latency = {}, {}
+    t0 = time.monotonic()
+    end = 0.0
+    for batch in batches:
+        ready = max(arrivals[i] for i in batch)
+        now = time.monotonic() - t0
+        if now < ready:
+            time.sleep(ready - now)
+        gen([prompts[i] for i in batch])
+        end = time.monotonic() - t0
+        for i in batch:
+            ttft[i] = end - arrivals[i]   # one-shot does not stream
+            latency[i] = end - arrivals[i]
+    useful = int(np.sum(budgets))  # over-generated tail rows are waste
+    return {
+        "tokens_per_sec": useful / end,
+        "useful_tokens": useful,
+        "generated_tokens": int(len(batches) * cap * max_budget),
+        "makespan_s": end,
+        "ttft_p50": percentile(list(ttft.values()), 50),
+        "ttft_p99": percentile(list(ttft.values()), 99),
+        "latency_p50": percentile(list(latency.values()), 50),
+        "latency_p99": percentile(list(latency.values()), 99),
+    }
+
+
+def main():
+    args = parser.parse_args()
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32, dim=args.dim,
+                                  n_layers=args.layers,
+                                  hidden_dim=2 * args.dim)
+    variables = models.Llama(cfg).init(jax.random.PRNGKey(1),
+                                       jnp.zeros((2, 4), jnp.int32))
+    arrivals, prompts, budgets = make_trace(args)
+    for p, b in zip(prompts, budgets):
+        assert p.size + b <= args.max_len
+
+    cont = run_continuous(variables, cfg, args, arrivals, prompts, budgets)
+    stat = run_static(variables, cfg, args, arrivals, prompts, budgets)
+    rec = {
+        "bench": "serving_poisson",
+        "config": {
+            "model": f"tiny(dim={args.dim},layers={args.layers})",
+            "num_requests": args.num_requests,
+            "rate_rps": args.rate, "capacity": args.capacity,
+            "max_len": args.max_len, "prefill_chunk": args.prefill_chunk,
+            "decode_horizon": args.decode_horizon,
+            "prefill_budget": args.prefill_budget,
+            "prompt_len": list(args.prompt_len),
+            "new_tokens": list(args.new_tokens), "seed": args.seed,
+            "backend": jax.default_backend(),
+        },
+        "continuous": cont,
+        "static": stat,
+        "speedup_tokens_per_sec":
+            cont["tokens_per_sec"] / stat["tokens_per_sec"],
+    }
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    print(json.dumps(rec, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -668,9 +668,13 @@ def win_unlock(name: str):
 
 
 def win_fence(name: str):
-    _wm().window(name)
+    # fence BOTH the window value and the mailbox: win_put with
+    # self_weight rebinds win.value (the in-place local scale), so a
+    # fence that only drained the mailbox could return while the scaled
+    # self value is still in flight (round-5 verdict item 7)
+    win = _wm().window(name)
     with ctx_mod._watchdog.watch(f"win_fence.{name}"):
-        jax.block_until_ready(_wm().window(name).mailbox)
+        jax.block_until_ready((win.value, win.mailbox))
 
 
 def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:

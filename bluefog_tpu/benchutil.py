@@ -17,7 +17,7 @@ import jax
 import numpy as np
 
 __all__ = ["device_fetch", "fetch_overhead", "timed",
-           "chain_time", "fwd_bwd_time",
+           "chain_time", "fwd_bwd_time", "poisson_arrivals",
            "chip_peak_flops", "chip_hbm_bandwidth", "compiled_step_flops",
            "mfu", "hlo_collective_bytes",
            "scheduled_collective_windows", "overlap_accounting",
@@ -514,6 +514,22 @@ def overlap_accounting(hlo_text: str,
         "fraction": (good / total) if total else 0.0,
         "windows": windows,
     }
+
+
+def poisson_arrivals(rate: float, n: int, seed: int = 0) -> np.ndarray:
+    """Arrival times (seconds, ascending, starting at 0.0) of ``n``
+    Poisson arrivals at ``rate`` requests/s: the cumulative sum of
+    seeded exponential inter-arrival gaps.  Pure function of
+    ``(rate, n, seed)`` — no wall clock anywhere — so the serving bench
+    and the serving tests replay the SAME trace
+    (benchmarks/serving_bench.py, tests/test_serving.py)."""
+    if rate <= 0:
+        raise ValueError(f"rate ({rate}) must be positive")
+    if n < 1:
+        return np.zeros((0,), np.float64)
+    gaps = np.random.RandomState(seed).exponential(1.0 / rate, size=n)
+    gaps[0] = 0.0
+    return np.cumsum(gaps)
 
 
 def device_fetch(a) -> np.ndarray:

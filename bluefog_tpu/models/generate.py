@@ -25,7 +25,8 @@ from jax import lax
 
 from bluefog_tpu.models.llama import Llama, LlamaConfig
 
-__all__ = ["init_cache", "llama_generate"]
+__all__ = ["init_cache", "llama_generate", "decode_config",
+           "prefill_cache", "decode_token_step"]
 
 
 def _decode_cfg(cfg: LlamaConfig, max_len: int, keep_tp: bool = False,
@@ -87,6 +88,41 @@ def _decode_cfg(cfg: LlamaConfig, max_len: int, keep_tp: bool = False,
         vocab_parallel=False, tp_seq_shard=False, **moe, **tp)
 
 
+def decode_config(cfg: LlamaConfig, max_len: int, *, keep_tp: bool = False,
+                  kv_quant: str = "none", weight_quant: str = "none",
+                  decode_attn: str = "xla") -> LlamaConfig:
+    """Public form of the decode-layout transform: the config a K/V-cached
+    decode program runs under (``decode=True``, cache length ``max_len``,
+    training-time mesh knobs cleared; see :func:`_decode_cfg`).  The
+    serving engine (``bluefog_tpu.serving``) builds its resident model
+    from this, so engine steps and :func:`llama_generate` share one
+    definition of "the decode layout" — and therefore one numerics."""
+    return _decode_cfg(cfg, max_len, keep_tp=keep_tp, kv_quant=kv_quant,
+                       weight_quant=weight_quant, decode_attn=decode_attn)
+
+
+def prefill_cache(model: Llama, params, cache, tokens: jax.Array):
+    """Cache-writing prefill: one multi-token forward writes ``tokens``'s
+    K/V into ``cache`` at its current index.  Returns ``(logits, cache')``
+    with ``logits [B, T, V]``.  ``params`` is the bare param tree (not the
+    ``{"params": ...}`` wrapper).  Shared by :func:`llama_generate`'s
+    one-shot path and the serving engine's chunked prefill — both are
+    this exact call, so their numerics agree token for token."""
+    logits, mut = model.apply({"params": params, "cache": cache}, tokens,
+                              mutable=["cache"])
+    return logits, mut["cache"]
+
+
+def decode_token_step(model: Llama, params, cache, tok: jax.Array):
+    """One incremental decode step: append ``tok [B, 1]``'s K/V and return
+    ``(last_logits [B, V], cache')``.  The single-token twin of
+    :func:`prefill_cache`, shared by the one-shot scan body and the
+    serving engine's slot-batched step."""
+    logits, mut = model.apply({"params": params, "cache": cache}, tok,
+                              mutable=["cache"])
+    return logits[:, -1], mut["cache"]
+
+
 def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
                keep_tp: bool = False, kv_quant: str = "none"):
     """Zero K/V caches for ``batch_size`` sequences of up to ``max_len``
@@ -109,7 +145,8 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
                    max_len: Optional[int] = None,
                    mesh=None, kv_quant: str = "none",
                    weight_quant: str = "none",
-                   decode_attn: str = "auto") -> jax.Array:
+                   decode_attn: str = "auto",
+                   eos_id: Optional[int] = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     Args:
@@ -147,6 +184,13 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
         200M B8/B32/1B), xla for int8 caches and long context
         (decode_*_r05.json).  Measure: examples/decode_benchmark.py
         ``--decode-attn``.
+      eos_id: early-stop token id.  Once a row emits ``eos_id`` its
+        remaining positions are frozen to ``eos_id`` (the done mask rides
+        the ``lax.scan`` carry, so finished rows stop emitting sampled
+        tokens); rows that never emit it are bit-identical to the
+        unstopped path.  ``None`` (default) disables the check.  Static:
+        switching eos ids compiles a new program (one id per served
+        model in practice).
 
     Returns ``[B, T_prompt + max_new_tokens]`` int32: prompt ‖ generation.
     """
@@ -181,22 +225,24 @@ def llama_generate(variables, cfg: LlamaConfig, prompt: jax.Array,
         # single-chip behavior).
         dcfg = _decode_cfg(cfg, max_len, keep_tp=True, **quant)
         fn = _tp_generate_program(dcfg, max_new_tokens,
-                                  temperature == 0.0, max_len, mesh)
+                                  temperature == 0.0, max_len, mesh,
+                                  eos_id)
         return fn(variables["params"], prompt, jnp.float32(temperature),
                   rng)
     return _generate_impl(
         variables, prompt, jnp.float32(temperature), rng,
         cfg=_decode_cfg(cfg, max_len, **quant),
         max_new_tokens=max_new_tokens,
-        greedy=temperature == 0.0, max_len=max_len)
+        greedy=temperature == 0.0, max_len=max_len, eos_id=eos_id)
 
 
 def _generate_body(variables, prompt, temperature, rng, *,
                    cfg: LlamaConfig, max_new_tokens: int, greedy: bool,
-                   max_len: int) -> jax.Array:
+                   max_len: int,
+                   eos_id: Optional[int] = None) -> jax.Array:
     b = prompt.shape[0]
     model = Llama(cfg)
-    params = {"params": variables["params"]}
+    params = variables["params"]
     # cfg here is already the decode layout; keep_tp preserves its tp
     # knobs so the cache shapes are per-shard under the tp shard_map
     cache = init_cache(cfg, b, max_len, keep_tp=cfg.tp_size > 1,
@@ -209,21 +255,26 @@ def _generate_body(variables, prompt, temperature, rng, *,
             rng, logits_last / temperature, axis=-1).astype(jnp.int32)
 
     # prefill: one multi-token call writes the prompt K/V
-    logits, mut = model.apply({**params, "cache": cache}, prompt,
-                              mutable=["cache"])
+    logits, cache = prefill_cache(model, params, cache, prompt)
     rng, sub = jax.random.split(rng)
     tok = sample(logits[:, -1], sub)
 
     def step(carry, _):
-        cache, tok, rng = carry
-        logits, mut = model.apply({**params, "cache": cache}, tok[:, None],
-                                  mutable=["cache"])
+        cache, tok, rng, done = carry
+        last, cache = decode_token_step(model, params, cache, tok[:, None])
         rng, sub = jax.random.split(rng)
-        nxt = sample(logits[:, -1], sub)
-        return (mut["cache"], nxt, rng), tok
+        nxt = sample(last, sub)
+        if eos_id is not None:
+            # a row is done once it has EMITTED eos; its later positions
+            # freeze to eos_id (the already-emitted tok passes through
+            # untouched — the first eos itself is part of the output)
+            done = done | (tok == eos_id)
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+        return (cache, nxt, rng, done), tok
 
-    (_, last, _), toks = lax.scan(step, (mut["cache"], tok, rng), None,
-                                  length=max_new_tokens - 1)
+    done0 = jnp.zeros((b,), bool)
+    (_, last, _, _), toks = lax.scan(step, (cache, tok, rng, done0), None,
+                                     length=max_new_tokens - 1)
     generated = jnp.concatenate(
         [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1) \
         if max_new_tokens > 1 else tok[:, None]
@@ -231,12 +282,13 @@ def _generate_body(variables, prompt, temperature, rng, *,
 
 
 _generate_impl = partial(jax.jit, static_argnames=(
-    "cfg", "max_new_tokens", "greedy", "max_len"))(_generate_body)
+    "cfg", "max_new_tokens", "greedy", "max_len", "eos_id"))(_generate_body)
 
 
 @functools.lru_cache(maxsize=8)
 def _tp_generate_program(dcfg: LlamaConfig, max_new_tokens: int,
-                         greedy: bool, max_len: int, mesh):
+                         greedy: bool, max_len: int, mesh,
+                         eos_id: Optional[int] = None):
     """Cached jitted shard_map program for tp-sharded decode — a serving
     loop reuses ONE compilation per (config, token budget, mesh).  The
     param partition specs derive from the config alone (via eval_shape),
@@ -260,7 +312,8 @@ def _tp_generate_program(dcfg: LlamaConfig, max_new_tokens: int,
     def body(params, prompt, temperature, rng):
         return _generate_body(
             {"params": params}, prompt, temperature, rng, cfg=dcfg,
-            max_new_tokens=max_new_tokens, greedy=greedy, max_len=max_len)
+            max_new_tokens=max_new_tokens, greedy=greedy, max_len=max_len,
+            eos_id=eos_id)
 
     sm = jax.shard_map(body, mesh=mesh, in_specs=(pspecs, P(), P(), P()),
                        out_specs=P(), check_vma=False)

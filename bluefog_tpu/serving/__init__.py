@@ -1,0 +1,19 @@
+"""Continuous-batching serving engine.
+
+Turns the K/V-cached decode substrate (``models/generate.py``,
+``models/quant.py``, ``parallel/pallas_decode.py``) into the serving
+path the ROADMAP north star requires: a slot-pooled resident program
+that admits requests as they arrive, mixes chunked prefill with batched
+decode every step, and retires slots on EOS / budget / deadline —
+no recompiles across arrival patterns, token-exact with the one-shot
+``llama_generate`` path.  See docs/serving.md.
+"""
+
+from bluefog_tpu.serving.engine import (Request, RequestRejected,
+                                        ServingEngine)
+from bluefog_tpu.serving.kv_pool import SlotPool
+from bluefog_tpu.serving.metrics import ServingMetrics, percentile
+from bluefog_tpu.serving.scheduler import FifoScheduler
+
+__all__ = ["ServingEngine", "Request", "RequestRejected", "SlotPool",
+           "FifoScheduler", "ServingMetrics", "percentile"]

@@ -1,0 +1,466 @@
+"""Continuous-batching inference engine.
+
+One-shot :func:`~bluefog_tpu.models.generate.llama_generate` is a
+benchmark artifact: fixed batch, fixed prompt length, everyone finishes
+together.  A server sees none of that — requests arrive whenever,
+prompts differ, budgets differ — and a bandwidth-bound TPU decode loop
+that waits for batch formation or pads dead rows is idle silicon.  This
+engine keeps ONE resident jitted program busy across an arbitrary
+arrival pattern:
+
+* every request owns a **slot** of the fixed-capacity K/V pool
+  (:class:`~bluefog_tpu.serving.kv_pool.SlotPool`);
+* each host-loop :meth:`~ServingEngine.step` admits queued requests and
+  runs up to ``prefill_budget`` **chunked-prefill** calls (fixed chunk
+  shape — a long prompt spreads over several steps instead of stalling
+  running decodes), then advances EVERY active slot ``decode_horizon``
+  tokens in a single vmapped program with a per-slot active mask and
+  per-slot cache index;
+* slots retire on EOS / token budget / deadline / cancellation and are
+  zeroed for reuse.
+
+There are exactly three compiled programs per model config — prefill
+chunk, decode horizon, slot zero — and their shapes depend only on
+``(capacity, max_len, prefill_chunk, decode_horizon)``, never on the
+arrival pattern: no recompiles across requests.
+
+Numerics are the one-shot path's numerics: both are built from the same
+:func:`prefill_cache` / :func:`decode_token_step` pieces, so a GREEDY
+request served through the engine reproduces its one-shot
+``llama_generate(prompt[None], n, max_len=pool_max_len)`` output token
+for token (tests/test_serving.py).  Temperature sampling is
+deterministic per request (the rng folds the request seed with the
+token index) but uses a different rng chain than the one-shot scan, so
+sampled streams are engine-reproducible, not one-shot-identical.
+Chunked prefill stays exact because
+attention is causal: a padded chunk's real rows never attend to the pad
+tail, and the corrected per-slot cache index masks the tail until real
+tokens overwrite it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bluefog_tpu.models.generate import (decode_config, decode_token_step,
+                                         prefill_cache)
+from bluefog_tpu.models.llama import Llama, LlamaConfig
+from bluefog_tpu.serving.kv_pool import SlotPool
+from bluefog_tpu.serving.metrics import ServingMetrics
+from bluefog_tpu.serving.scheduler import FifoScheduler, RequestRejected
+
+__all__ = ["ServingEngine", "Request", "RequestRejected"]
+
+_rid_counter = itertools.count()
+
+# terminal / live request states
+QUEUED, PREFILL, DECODE = "queued", "prefill", "decode"
+COMPLETED, CANCELLED, REJECTED = "completed", "cancelled", "rejected"
+
+
+@dataclasses.dataclass(eq=False)  # identity semantics: the scheduler
+# removes by object (a generated __eq__ would compare prompt arrays)
+class Request:
+    """One generation request.
+
+    ``deadline`` is in absolute engine-clock seconds (the engine's
+    injected ``clock``, ``time.monotonic`` by default): a request that
+    has not RETIRED by its deadline is cancelled — queued ones are shed
+    without ever touching the device.  ``temperature``/``seed`` drive
+    per-request sampling (greedy at 0.0); sampling is deterministic
+    given the seed and independent of what the request is co-batched
+    with (the rng folds in the per-request token index, not the engine
+    step)."""
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    temperature: float = 0.0
+    seed: int = 0
+    deadline: Optional[float] = None
+    rid: int = dataclasses.field(default_factory=lambda: next(_rid_counter))
+
+    # engine-owned state
+    state: str = dataclasses.field(default=QUEUED, init=False)
+    tokens: List[int] = dataclasses.field(default_factory=list, init=False)
+    slot: Optional[int] = dataclasses.field(default=None, init=False)
+    _prefill_pos: int = dataclasses.field(default=0, init=False)
+    _cancel: bool = dataclasses.field(default=False, init=False)
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens ({self.max_new_tokens}) must be >= 1")
+
+    @property
+    def done(self) -> bool:
+        return self.state in (COMPLETED, CANCELLED, REJECTED)
+
+    def output(self) -> np.ndarray:
+        """prompt ‖ generated tokens (no padding — streaming semantics:
+        exactly what was emitted, EOS included when it fired)."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+def _sample(logits, key, temp):
+    """Per-row sampling: greedy argmax at temp 0.0 (bit-identical to the
+    one-shot path), categorical otherwise.  Both branches are computed
+    and selected by ``where`` so temperature stays a traced operand."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    sampled = jax.random.categorical(
+        key, logits / jnp.maximum(temp, 1e-6), axis=-1).astype(jnp.int32)
+    return jnp.where(temp > 0.0, sampled, greedy)
+
+
+def _corrected_index(new_cache, old_cache, valid_len):
+    """Rewrite every ``cache_index`` leaf to ``old + valid_len``: the
+    model advanced the index by the full (padded) chunk length; the
+    request only wrote ``valid_len`` real tokens.  The pad tail's K/V
+    stays in the cache but above the index, where the causal mask hides
+    it until real tokens overwrite it — exactness needs only the index."""
+    def fix(path, new, old):
+        name = getattr(path[-1], "key", None)
+        if name == "cache_index":
+            return old + jnp.asarray(valid_len, old.dtype)
+        return new
+
+    return jax.tree_util.tree_map_with_path(fix, new_cache, old_cache)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _prefill_chunk_prog(params, pool, slot, chunk, valid_len,
+                        cfg: LlamaConfig):
+    """Write one fixed-shape prompt chunk into ``slot``'s cache.  Only
+    the K/V side effect matters: the engine prefills ``prompt[:-1]``
+    through chunks (their logits are never sampled — in decode layout
+    the model only materializes the FINAL position's logits, which for a
+    padded chunk is a pad row) and routes the last prompt token through
+    the regular decode step, whose output IS the first generated token.
+    Shapes depend on ``(cfg, chunk_len)`` alone."""
+    model = Llama(cfg)
+    cache = jax.tree.map(
+        lambda leaf: lax.dynamic_index_in_dim(leaf, slot, 0,
+                                              keepdims=False), pool)
+    _, new_cache = prefill_cache(model, params, cache, chunk)
+    new_cache = _corrected_index(new_cache, cache, valid_len)
+    return jax.tree.map(
+        lambda p, c: lax.dynamic_update_index_in_dim(p, c, slot, 0),
+        pool, new_cache)
+
+
+@partial(jax.jit, static_argnames=("cfg", "horizon"), donate_argnums=(1,))
+def _decode_step_prog(params, pool, toks, active, keys, counts, temps,
+                      cfg: LlamaConfig, horizon: int):
+    """Advance EVERY slot ``horizon`` decode tokens (vmapped
+    single-token steps inside one ``lax.scan`` — each slot carries its
+    own cache index, so rotary/mask positions are per-request) and
+    freeze inactive slots' caches via the mask.  Inactive slots still
+    compute — that is the fixed-shape price that buys zero recompiles —
+    but their state is bit-frozen.
+
+    ``horizon`` amortizes the host loop (dispatch + token fetch) over
+    several tokens; each token is the SAME per-slot step (and the rng
+    folds in the per-request token index), so the emitted stream is
+    identical for every horizon — the host truncates a retiring slot's
+    surplus tail, and the slot's zero-on-free makes its overrun cache
+    writes unobservable.  Returns ``(pool, tokens [horizon, n_slots])``.
+    """
+    model = Llama(cfg)
+
+    def keep_index(path, new, old):
+        # Freezing an inactive slot needs only its cache_index: the
+        # step's K/V write lands AT the frozen index, where the causal
+        # mask hides it until something real overwrites it — the next
+        # prefill chunk (mid-admission slots), the next real decode
+        # write, or the zero-on-free (free slots).  Masking just the
+        # index leaves skips two whole-pool copies per token.
+        if getattr(path[-1], "key", None) != "cache_index":
+            return new
+        m = active.reshape(active.shape + (1,) * (new.ndim - 1))
+        return jnp.where(m, new, old)
+
+    def hstep(carry, j):
+        pool, toks = carry
+
+        def one(cache, tok, key, count, temp):
+            last, cache = decode_token_step(model, params, cache,
+                                            tok[None, None])
+            nxt = _sample(last[0], jax.random.fold_in(key, count + j),
+                          temp)
+            return cache, nxt
+
+        new_pool, nxt = jax.vmap(one)(pool, toks, keys, counts, temps)
+        nxt = jnp.where(active, nxt, toks)
+        return (jax.tree_util.tree_map_with_path(keep_index, new_pool,
+                                                 pool), nxt), nxt
+
+    (pool, _), hist = lax.scan(hstep, (pool, toks),
+                               jnp.arange(horizon, dtype=jnp.int32))
+    return pool, hist
+
+
+class ServingEngine:
+    """Continuous-batching serving loop over a :class:`SlotPool`.
+
+    Args:
+      variables: ``{"params": ...}`` (full-precision, or the
+        ``quantize_llama_params`` tree with ``weight_quant`` set — same
+        contract as ``llama_generate``).
+      cfg: model config (training layout fine; normalized through
+        :func:`decode_config`).
+      capacity: resident request slots (= decode batch).
+      max_len: per-slot cache length; every request needs
+        ``len(prompt) + max_new_tokens <= max_len`` (checked at submit).
+      prefill_chunk: fixed prompt-chunk length; must divide ``max_len``
+        (chunk windows then never cross the cache end — an overrunning
+        ``dynamic_update_slice`` start would CLAMP, silently corrupting
+        near-``max_len`` prompts).  Smaller chunks bound how long
+        running decodes stall behind one admission; larger chunks
+        finish prefill in fewer steps.
+      decode_horizon: tokens every active slot advances per host
+        iteration (one inner ``lax.scan``).  1 = lowest TTFT and
+        per-token scheduling; larger values amortize host dispatch over
+        the horizon (throughput mode — retirements, admissions, and
+        deadline checks happen at horizon boundaries).  The emitted
+        streams are identical for every horizon.
+      prefill_budget: max prefill CHUNKS one step may run (admissions
+        continue until the budget or the pool is exhausted).  1
+        (default) bounds per-step admission work to one chunk — the
+        lowest decode jitter; raise it alongside ``decode_horizon`` so
+        admission keeps the pool full in throughput mode.
+      max_queue: backpressure bound — submits beyond it raise
+        :class:`RequestRejected` with the queue depth attached.
+      clock: injectable monotonic clock (tests drive virtual time; the
+        Poisson bench uses the default ``time.monotonic``).
+      decode_attn: attention lowering for the resident programs ("xla"
+        default — the vmapped per-slot step; the fused Pallas kernel is
+        a single-request-batch kernel, measure before switching).
+    """
+
+    def __init__(self, variables, cfg: LlamaConfig, *, capacity: int,
+                 max_len: int, prefill_chunk: int = 32,
+                 decode_horizon: int = 1, prefill_budget: int = 1,
+                 kv_quant: str = "none", weight_quant: str = "none",
+                 max_queue: int = 64,
+                 clock: Optional[Callable[[], float]] = None,
+                 decode_attn: str = "xla"):
+        from bluefog_tpu.models.quant import is_quantized_params
+
+        if (weight_quant != "none") != is_quantized_params(variables):
+            raise ValueError(
+                "weight_quant='int8'/'w8a8' requires params converted by "
+                "quantize_llama_params (and full-precision params require "
+                "weight_quant='none'); got a mismatched tree")
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk ({prefill_chunk}) must be "
+                             ">= 1")
+        if max_len % prefill_chunk != 0:
+            # chunk writes land at multiples of prefill_chunk, so this
+            # guarantees no chunk's fixed-size window crosses max_len —
+            # XLA CLAMPS an out-of-range dynamic_update_slice start,
+            # which would silently overwrite earlier K/V positions for
+            # near-max_len prompts instead of erroring
+            raise ValueError(
+                f"prefill_chunk ({prefill_chunk}) must divide max_len "
+                f"({max_len}) so no chunk window crosses the cache end")
+        if decode_horizon < 1:
+            raise ValueError(f"decode_horizon ({decode_horizon}) must be "
+                             ">= 1")
+        if prefill_budget < 1:
+            raise ValueError(f"prefill_budget ({prefill_budget}) must be "
+                             ">= 1")
+        self.cfg = decode_config(cfg, max_len, kv_quant=kv_quant,
+                                 weight_quant=weight_quant,
+                                 decode_attn=decode_attn)
+        self.pool = SlotPool(cfg, capacity, max_len, kv_quant=kv_quant)
+        self.scheduler = FifoScheduler(max_queue=max_queue)
+        self.metrics = ServingMetrics()
+        self.prefill_chunk = prefill_chunk
+        self.decode_horizon = decode_horizon
+        self.prefill_budget = prefill_budget
+        self.clock = clock or time.monotonic
+        self._params = variables["params"]
+        self._running: Dict[int, Request] = {}   # slot -> request
+        self._admitting: Optional[Request] = None  # mid-prefill request
+        self._requests: Dict[int, Request] = {}  # rid -> request
+
+    # -- submission ---------------------------------------------------- #
+    def submit(self, request: Request) -> Request:
+        """Enqueue a request.  Raises :class:`RequestRejected` under
+        backpressure (queue at ``max_queue``) and ``ValueError`` when the
+        request cannot fit a slot at all."""
+        total = request.prompt.size + request.max_new_tokens
+        if total > self.pool.max_len:
+            raise ValueError(
+                f"request needs {total} cache positions but slots hold "
+                f"{self.pool.max_len} (prompt {request.prompt.size} + "
+                f"max_new_tokens {request.max_new_tokens})")
+        now = self.clock()
+        try:
+            self.scheduler.submit(request)
+        except RequestRejected:
+            request.state = REJECTED
+            self.metrics.on_reject(request.rid, now)
+            raise
+        self._requests[request.rid] = request
+        self.metrics.on_submit(request.rid, now)
+        return request
+
+    def cancel(self, request: Request) -> bool:
+        """Cancel a queued or running request (idempotent; False once the
+        request already retired)."""
+        if request.done:
+            return False
+        if self.scheduler.cancel(request):
+            request.state = CANCELLED
+            self.metrics.on_retire(request.rid, self.clock(), CANCELLED)
+            return True
+        request._cancel = True  # picked up at the next step boundary
+        return True
+
+    # -- the serving loop --------------------------------------------- #
+    def step(self) -> bool:
+        """One engine iteration: shed/cancel, admit + one prefill chunk,
+        one decode step over all active slots.  Returns True while there
+        is live work (queued, prefilling, or decoding)."""
+        now = self.clock()
+        # 1. deadline shedding in the queue (zero device cost)
+        for req in self.scheduler.expire(now):
+            req.state = CANCELLED
+            self.metrics.on_retire(req.rid, now, CANCELLED)
+        # 2. running cancellations (explicit or deadline) — including a
+        #    request still mid-prefill, whose slot must come back too
+        live = list(self._running.values())
+        if self._admitting is not None:
+            live.append(self._admitting)
+        for req in live:
+            if req._cancel or (req.deadline is not None
+                               and now >= req.deadline):
+                self._retire(req, CANCELLED, now)
+        # 3+4. admission + chunked prefill, bounded by the per-step
+        #      chunk budget (prefill work is what stalls running
+        #      decodes, so IT is what gets budgeted — not admissions)
+        chunks = 0
+        while chunks < self.prefill_budget:
+            if self._admitting is None:
+                if self.pool.n_free == 0:
+                    break
+                req = self.scheduler.admit(now)
+                if req is None:
+                    break
+                req.slot = self.pool.alloc()
+                self.metrics.on_admit(req.rid, now)
+                if req.prompt.size > 1:
+                    req.state = PREFILL
+                    self._admitting = req
+                else:  # single-token prompt: nothing to prefill — the
+                    # decode step consumes the whole prompt directly
+                    req.state = DECODE
+                    self._running[req.slot] = req
+                    continue
+            self._prefill_one_chunk(self._admitting)
+            chunks += 1
+        # 5. one decode token for every active slot
+        decoding = {s: r for s, r in self._running.items()
+                    if r.state == DECODE}
+        if decoding:
+            self._decode_step(decoding)
+        self.metrics.on_step(self.pool.occupancy(),
+                             self.scheduler.queue_depth)
+        return bool(self._running or self._admitting
+                    or self.scheduler.queue_depth)
+
+    def run(self, max_steps: int = 100_000) -> None:
+        """Drive :meth:`step` until idle (drain the queue and every
+        slot); ``max_steps`` guards against a caller submitting faster
+        than the loop drains."""
+        for _ in range(max_steps):
+            if not self.step():
+                return
+        raise RuntimeError(f"engine still busy after {max_steps} steps")
+
+    # -- internals ----------------------------------------------------- #
+    def _prefill_one_chunk(self, req: Request) -> None:
+        # chunks cover prompt[:-1] — the K/V everyone after needs; the
+        # final prompt token goes through the decode step below, whose
+        # logits yield the request's first generated token (the exact
+        # split the one-shot path computes inside one big call)
+        c = self.prefill_chunk
+        pos = req._prefill_pos
+        n_prefill = req.prompt.size - 1
+        valid = min(c, n_prefill - pos)
+        chunk = np.zeros((1, c), np.int32)
+        chunk[0, :valid] = req.prompt[pos:pos + valid]
+        self.pool.cache = _prefill_chunk_prog(
+            self._params, self.pool.cache, jnp.int32(req.slot),
+            jnp.asarray(chunk), jnp.int32(valid), cfg=self.cfg)
+        req._prefill_pos = pos + valid
+        if req._prefill_pos < n_prefill:
+            return  # more chunks to go; decodes keep running meanwhile
+        self._admitting = None
+        self._running[req.slot] = req
+        req.state = DECODE
+
+    def _decode_step(self, decoding: Dict[int, Request]) -> None:
+        cap = self.pool.capacity
+        toks = np.zeros((cap,), np.int32)
+        active = np.zeros((cap,), bool)
+        keys = np.zeros((cap, 2), np.uint32)
+        counts = np.zeros((cap,), np.int32)
+        temps = np.zeros((cap,), np.float32)
+        for slot, req in decoding.items():
+            # first step after prefill consumes the LAST prompt token
+            # (writing its K/V and sampling the first generated token);
+            # afterwards the request's own stream feeds back
+            toks[slot] = req.tokens[-1] if req.tokens else req.prompt[-1]
+            active[slot] = True
+            keys[slot] = np.asarray(jax.random.PRNGKey(req.seed))
+            counts[slot] = len(req.tokens)
+            temps[slot] = req.temperature
+        self.pool.cache, hist = _decode_step_prog(
+            self._params, self.pool.cache, jnp.asarray(toks),
+            jnp.asarray(active), jnp.asarray(keys), jnp.asarray(counts),
+            jnp.asarray(temps), cfg=self.cfg,
+            horizon=self.decode_horizon)
+        hist = np.asarray(hist)  # the per-step host sync: tokens stream
+        now = self.clock()
+        for slot, req in decoding.items():
+            for j in range(self.decode_horizon):
+                first = not req.tokens
+                req.tokens.append(int(hist[j, slot]))
+                if first:
+                    self.metrics.on_first_token(req.rid, now)
+                else:
+                    self.metrics.on_token(req.rid, now)
+                if self._maybe_finish(req):
+                    break  # surplus horizon tokens for a retired slot
+                    # are discarded (its cache is zeroed on free)
+
+    def _maybe_finish(self, req: Request) -> bool:
+        hit_eos = (req.eos_id is not None
+                   and req.tokens[-1] == req.eos_id)
+        if hit_eos or len(req.tokens) >= req.max_new_tokens:
+            self._retire(req, COMPLETED, self.clock())
+            return True
+        return False
+
+    def _retire(self, req: Request, outcome: str, now: float) -> None:
+        if req is self._admitting:
+            self._admitting = None
+        self._running.pop(req.slot, None)
+        self.pool.free(req.slot)
+        req.slot = None
+        req.state = outcome
+        self.metrics.on_retire(req.rid, now, outcome)

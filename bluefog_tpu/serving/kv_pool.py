@@ -1,0 +1,100 @@
+"""Slot-pooled K/V caches for the continuous-batching engine.
+
+One resident jitted program serves many requests by giving every request
+a SLOT: index ``i`` of a fixed-capacity stacked cache tree whose leaves
+are ``[capacity, *single_request_cache_shape]`` (the shapes
+:func:`bluefog_tpu.models.generate.init_cache` builds for batch size 1,
+in either the full-precision or the int8+scale layout).  Slot shapes are
+functions of ``(capacity, max_len)`` only — never of the arrival
+pattern — which is what keeps the engine free of recompiles.
+
+Allocation is host-side bookkeeping (a free list); the device tree is
+mutated only through the engine's jitted programs.  Freeing a slot
+zeroes it with one jitted donated scatter, so a reused slot starts from
+the exact state a fresh pool has — "slot reuse is invisible" is a
+testable property, not an argument about masked garbage.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bluefog_tpu.models.generate import decode_config, init_cache
+from bluefog_tpu.models.llama import LlamaConfig
+
+__all__ = ["SlotPool"]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _zero_slot(pool, slot):
+    return jax.tree.map(
+        lambda leaf: leaf.at[slot].set(jnp.zeros((), leaf.dtype)), pool)
+
+
+class SlotPool:
+    """Fixed-capacity pool of per-request K/V caches.
+
+    Args:
+      cfg: the model's config (training layout fine — normalized through
+        :func:`decode_config` internally, same as ``llama_generate``).
+      capacity: number of resident request slots.  Decode advances ALL
+        slots every step (inactive ones are masked), so capacity is the
+        decode batch size the hardware is sized for.
+      max_len: per-slot cache length (prompt + generation budget ceiling
+        for any single request).
+      kv_quant: "none" | "int8" — the cache layout
+        (``models/generate.py``); int8 halves decode's cache traffic.
+    """
+
+    def __init__(self, cfg: LlamaConfig, capacity: int, max_len: int,
+                 kv_quant: str = "none"):
+        if capacity < 1:
+            raise ValueError(f"capacity ({capacity}) must be >= 1")
+        dcfg = decode_config(cfg, max_len, kv_quant=kv_quant)
+        slot_shapes = jax.eval_shape(
+            lambda: init_cache(dcfg, 1, max_len, kv_quant=kv_quant))
+        self.cache = jax.tree.map(
+            lambda s: jnp.zeros((capacity,) + s.shape, s.dtype),
+            slot_shapes)
+        self.capacity = capacity
+        self.max_len = max_len
+        self.kv_quant = kv_quant
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self._in_use: set = set()
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_active(self) -> int:
+        return len(self._in_use)
+
+    def occupancy(self) -> float:
+        """Fraction of slots holding a live request (a serving metric:
+        idle slots are decode compute spent on nothing)."""
+        return len(self._in_use) / self.capacity
+
+    def alloc(self) -> Optional[int]:
+        """Claim a slot, or ``None`` when the pool is full (the scheduler
+        turns ``None`` into queueing/backpressure — the pool never
+        blocks)."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._in_use.add(slot)
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Return ``slot`` to the pool and zero its cache (index AND
+        contents), so the next request admitted into it sees exactly the
+        fresh-pool state."""
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        self._in_use.remove(slot)
+        self._free.append(slot)
+        self.cache = _zero_slot(self.cache, jnp.int32(slot))

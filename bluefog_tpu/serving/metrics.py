@@ -1,0 +1,159 @@
+"""Serving metrics + request-lifecycle timeline spans.
+
+Numbers a serving operator actually pages on:
+
+* **TTFT** (time to first token): submit -> first generated token, the
+  user-visible latency of the prefill path + queueing.
+* **Request latency**: submit -> retire.
+* **Aggregate tokens/s**: generated tokens over the serving window — the
+  throughput continuous batching exists to maximize.
+* **Slot occupancy / queue depth**: sampled once per engine step; low
+  occupancy under load means admission is the bottleneck, deep queues
+  mean capacity is.
+
+Lifecycle spans go through the existing :mod:`bluefog_tpu.timeline`
+writer (same chrome://tracing file format as the op-level spans), one
+track per request: ``admission -> prefill -> decode -> retire``.  Load a
+timeline in chrome://tracing and the continuous-batching interleaving is
+visible directly — staggered prefills riding between decode steps.
+
+All timestamps come from the engine's injected clock, so tests drive
+virtual time and percentiles are deterministic.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from bluefog_tpu import timeline as timeline_mod
+
+__all__ = ["ServingMetrics", "percentile"]
+
+
+def percentile(values, q: float) -> float:
+    """Linear-interpolation percentile (numpy's default); 0.0 on empty —
+    summaries stay total-function even for a load that never finished a
+    request."""
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return 0.0
+    return float(np.percentile(np.asarray(vals, np.float64), q))
+
+
+class _RequestRecord:
+    __slots__ = ("submit_t", "admit_t", "first_token_t", "finish_t",
+                 "n_tokens", "outcome")
+
+    def __init__(self, submit_t: float):
+        self.submit_t = submit_t
+        self.admit_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.finish_t: Optional[float] = None
+        self.n_tokens = 0
+        self.outcome: Optional[str] = None
+
+
+class ServingMetrics:
+    def __init__(self):
+        self._req: Dict[object, _RequestRecord] = {}
+        self._occupancy: List[float] = []
+        self._queue_depth: List[int] = []
+        self.n_rejected = 0
+
+    # -- timeline plumbing -------------------------------------------- #
+    def _span(self, rid, activity: Optional[str]):
+        """Close the request's open span and (unless retiring) open the
+        next lifecycle phase on its per-request track."""
+        tl = timeline_mod.get_timeline()
+        if tl is None:
+            return
+        track = f"request.{rid}"
+        tl.end_activity(track)
+        if activity is not None:
+            tl.start_activity(track, activity)
+
+    # -- lifecycle events (engine calls these) ------------------------ #
+    def on_submit(self, rid, now: float):
+        self._req[rid] = _RequestRecord(now)
+        tl = timeline_mod.get_timeline()
+        if tl is not None:
+            tl.start_activity(f"request.{rid}", "admission")
+
+    def on_reject(self, rid, now: float):
+        self.n_rejected += 1
+
+    def on_admit(self, rid, now: float):
+        self._req[rid].admit_t = now
+        self._span(rid, "prefill")
+
+    def on_first_token(self, rid, now: float):
+        rec = self._req[rid]
+        rec.first_token_t = now
+        rec.n_tokens += 1
+        self._span(rid, "decode")
+
+    def on_token(self, rid, now: float):
+        self._req[rid].n_tokens += 1
+
+    def on_retire(self, rid, now: float, outcome: str):
+        rec = self._req[rid]
+        rec.finish_t = now
+        rec.outcome = outcome
+        self._span(rid, "retire")
+        self._span(rid, None)
+        tl = timeline_mod.get_timeline()
+        if tl is not None:
+            tl.instant(f"request.{rid}.{outcome}")
+
+    def on_step(self, occupancy: float, queue_depth: int):
+        self._occupancy.append(occupancy)
+        self._queue_depth.append(queue_depth)
+
+    # -- summaries ----------------------------------------------------- #
+    def ttfts(self) -> List[float]:
+        return [r.first_token_t - r.submit_t for r in self._req.values()
+                if r.first_token_t is not None]
+
+    def latencies(self) -> List[float]:
+        return [r.finish_t - r.submit_t for r in self._req.values()
+                if r.finish_t is not None]
+
+    def summary(self) -> dict:
+        """One dict with the operator dashboard: percentile latencies,
+        aggregate tokens/s over the active window, mean occupancy/queue
+        depth, and outcome counts."""
+        recs = list(self._req.values())
+        finished = [r for r in recs if r.finish_t is not None]
+        tokens = sum(r.n_tokens for r in recs)
+        if finished:
+            t0 = min(r.submit_t for r in recs)
+            t1 = max(r.finish_t for r in finished)
+            window = max(t1 - t0, 1e-12)
+        else:
+            window = 0.0
+        outcomes: Dict[str, int] = {}
+        for r in recs:
+            if r.outcome:
+                outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
+        ttft = self.ttfts()
+        lat = self.latencies()
+        return {
+            "n_requests": len(recs),
+            "n_finished": len(finished),
+            "n_rejected": self.n_rejected,
+            "outcomes": outcomes,
+            "tokens_generated": tokens,
+            "tokens_per_sec": (tokens / window) if window else 0.0,
+            "ttft_p50": percentile(ttft, 50),
+            "ttft_p99": percentile(ttft, 99),
+            "latency_p50": percentile(lat, 50),
+            "latency_p99": percentile(lat, 99),
+            "mean_slot_occupancy": (float(np.mean(self._occupancy))
+                                    if self._occupancy else 0.0),
+            "mean_queue_depth": (float(np.mean(self._queue_depth))
+                                 if self._queue_depth else 0.0),
+            "max_queue_depth": (int(np.max(self._queue_depth))
+                                if self._queue_depth else 0),
+        }

@@ -39,6 +39,13 @@ MODULES = [
     ("bluefog_tpu.models.llama", "Llama config/stack, TP/EP/vocab-parallel"),
     ("bluefog_tpu.models.generate", "K/V-cached autoregressive decode"),
     ("bluefog_tpu.models.quant", "int8 weight quantization for decode"),
+    ("bluefog_tpu.serving.engine",
+     "continuous-batching serving engine (slot-pooled K/V decode)"),
+    ("bluefog_tpu.serving.kv_pool", "fixed-capacity K/V cache slot pool"),
+    ("bluefog_tpu.serving.scheduler",
+     "FIFO admission, deadlines, backpressure"),
+    ("bluefog_tpu.serving.metrics",
+     "serving metrics (TTFT, tokens/s) + request timeline spans"),
     ("bluefog_tpu.parallel.collectives",
      "XLA collective data plane (mesh ops)"),
     ("bluefog_tpu.parallel.ring_attention", "ring/blockwise attention (SP)"),

@@ -62,6 +62,30 @@ def test_restore_mismatched_world_errors(tmp_path):
     ckpt.close()
 
 
+def test_restore_latest_mismatched_world_errors(tmp_path):
+    """The elastic-resume entry point (restore_latest, what a restarted
+    job actually calls) keeps the documented clear error when the new
+    mesh's rank axis does not match the checkpointed leading axis — and
+    refuses an empty directory with FileNotFoundError rather than a
+    bare orbax failure."""
+    mesh = _mesh(8)
+    ckpt = ckpt_mod.Checkpointer(str(tmp_path / "c"))
+    with pytest.raises(FileNotFoundError, match="no checkpoints"):
+        ckpt.restore_latest(mesh)
+    state = {"x": jax.device_put(np.zeros((8, 2), np.float32),
+                                 NamedSharding(mesh, P("bf"))),
+             "step": 7}
+    ckpt.save(0, state)
+    small_mesh = Mesh(np.array(jax.devices()[:4]), ("bf",))
+    with pytest.raises(ValueError, match="rank axis"):
+        ckpt.restore_latest(small_mesh)
+    # the same resume succeeds on a matching world
+    restored = ckpt.restore_latest(mesh)
+    np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                  np.zeros((8, 2)))
+    ckpt.close()
+
+
 def test_restore_without_mesh_gives_host_arrays(tmp_path):
     mesh = _mesh()
     ckpt = ckpt_mod.Checkpointer(str(tmp_path / "c"))

@@ -102,6 +102,13 @@ def test_resnet_benchmark_tiny():
     assert "img/sec" in out
 
 
+def test_serve_llama():
+    out = run_example("serve_llama.py", "--num-requests", "6", "--rate",
+                      "30", "--capacity", "2", "--max-len", "64")
+    assert "serving metrics:" in out
+    assert "completed" in out
+
+
 def test_decode_benchmark_tiny():
     out = run_example("decode_benchmark.py", "--model", "tiny",
                       "--batch-size", "2", "--prompt-len", "8",

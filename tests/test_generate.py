@@ -167,6 +167,40 @@ def test_tp_sharded_decode_sampling_agrees_across_shards():
     np.testing.assert_array_equal(a, b)
 
 
+def test_eos_unseen_matches_unstopped_path():
+    """eos_id parity: an eos that never fires leaves the output
+    bit-identical to the unstopped path (the done mask is pure
+    plumbing until it triggers)."""
+    cfg, _, variables, prompt = _setup(False)
+    plain = np.asarray(llama_generate(variables, cfg, jnp.asarray(prompt),
+                                      NEW))
+    unseen = [t for t in range(256)
+              if t not in plain[:, T_PROMPT:]][0]
+    stopped = np.asarray(llama_generate(variables, cfg,
+                                        jnp.asarray(prompt), NEW,
+                                        eos_id=unseen))
+    np.testing.assert_array_equal(stopped, plain)
+
+
+def test_eos_freezes_finished_rows():
+    """Once a row emits eos_id, every later position in that row is
+    eos_id padding; other rows keep generating their unstopped stream."""
+    cfg, _, variables, prompt = _setup(False)
+    plain = np.asarray(llama_generate(variables, cfg, jnp.asarray(prompt),
+                                      NEW))
+    # force row 0 to stop after its 3rd generated token
+    eos = int(plain[0, T_PROMPT + 2])
+    assert eos not in plain[0, T_PROMPT:T_PROMPT + 2]
+    got = np.asarray(llama_generate(variables, cfg, jnp.asarray(prompt),
+                                    NEW, eos_id=eos))
+    np.testing.assert_array_equal(got[0, :T_PROMPT + 3],
+                                  plain[0, :T_PROMPT + 3])
+    assert np.all(got[0, T_PROMPT + 3:] == eos)
+    for r in range(1, prompt.shape[0]):
+        if eos not in plain[r, T_PROMPT:]:
+            np.testing.assert_array_equal(got[r], plain[r])
+
+
 def test_generate_from_hf_import():
     """HF-imported weights decode directly."""
     torch = pytest.importorskip("torch")

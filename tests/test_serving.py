@@ -1,0 +1,427 @@
+"""Continuous-batching serving engine (bluefog_tpu/serving/).
+
+Contract under test: the engine is a pure SCHEDULING layer over the
+one-shot decode substrate — for any arrival pattern, every request's
+output is token-exact with its own one-shot
+``llama_generate(prompt[None], n, max_len=pool_max_len)`` call.  Plus
+the serving behaviors that make it an engine rather than a loop: slot
+reuse, EOS retirement, deadline cancellation, pool-full backpressure,
+metrics, and timeline spans.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bluefog_tpu import models
+from bluefog_tpu.models import llama_generate
+from bluefog_tpu.serving import (FifoScheduler, Request, RequestRejected,
+                                 ServingEngine, SlotPool)
+
+pytestmark = pytest.mark.serving
+
+MAX_LEN = 48
+
+
+class VirtualClock:
+    """Deterministic engine clock: tests advance time explicitly, so
+    deadline behavior and latency percentiles are reproducible."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _setup(**cfg_overrides):
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32, **cfg_overrides)
+    variables = models.Llama(cfg).init(jax.random.PRNGKey(1),
+                                       jnp.zeros((2, 4), jnp.int32))
+    return cfg, variables
+
+
+def _one_shot(variables, cfg, prompt, n, **kw):
+    out = llama_generate(variables, cfg, jnp.asarray(prompt[None]), n,
+                         max_len=MAX_LEN, **kw)
+    return np.asarray(out)[0]
+
+
+def _prompts(sizes, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 256, (n,)).astype(np.int32) for n in sizes]
+
+
+def test_staggered_arrivals_match_one_shot():
+    """The acceptance property: requests arriving at different engine
+    steps, with different prompt lengths and budgets, sharing 2 slots —
+    each output equals its per-request one-shot generation exactly."""
+    cfg, variables = _setup()
+    prompts = _prompts((5, 9, 3, 1))
+    budgets = [6, 4, 8, 5]
+    eng = ServingEngine(variables, cfg, capacity=2, max_len=MAX_LEN,
+                        prefill_chunk=4)
+    reqs = [Request(p, b) for p, b in zip(prompts, budgets)]
+    eng.submit(reqs[0])
+    eng.step()
+    eng.step()
+    eng.submit(reqs[1])
+    eng.step()
+    eng.submit(reqs[2])
+    eng.submit(reqs[3])
+    eng.run()
+    for r, p, b in zip(reqs, prompts, budgets):
+        assert r.state == "completed"
+        np.testing.assert_array_equal(
+            r.output(), _one_shot(variables, cfg, p, b))
+
+
+def test_scan_layers_layout_served():
+    """Both layer layouts decode through the engine (the scanned stack
+    carries a [n_layers] cache axis — slots stack outside it)."""
+    cfg, variables = _setup(scan_layers=True)
+    prompts = _prompts((4, 6))
+    eng = ServingEngine(variables, cfg, capacity=2, max_len=MAX_LEN,
+                        prefill_chunk=3)
+    reqs = [eng.submit(Request(p, 5)) for p in prompts]
+    eng.run()
+    for r, p in zip(reqs, prompts):
+        np.testing.assert_array_equal(
+            r.output(), _one_shot(variables, cfg, p, 5))
+
+
+def test_slot_reuse_is_invisible():
+    """capacity=1: the second request reuses the first's slot and still
+    matches one-shot exactly (freed slots are zeroed — reuse leaves no
+    trace)."""
+    cfg, variables = _setup()
+    prompts = _prompts((7, 5), seed=3)
+    eng = ServingEngine(variables, cfg, capacity=1, max_len=MAX_LEN,
+                        prefill_chunk=4)
+    r0 = eng.submit(Request(prompts[0], 6))
+    eng.step()  # r0 admitted into slot 0, mid-flight
+    r1 = eng.submit(Request(prompts[1], 6))
+    eng.run()
+    assert r0.slot is None and r1.slot is None
+    assert eng.pool.n_free == 1
+    for r, p in zip((r0, r1), prompts):
+        np.testing.assert_array_equal(
+            r.output(), _one_shot(variables, cfg, p, 6))
+
+
+def test_eos_retires_slot_and_truncates():
+    """A request whose stream hits its eos_id retires early: its output
+    is the one-shot prefix through the first EOS, and the freed slot
+    admits the next queued request."""
+    cfg, variables = _setup()
+    (prompt,) = _prompts((5,), seed=1)
+    full = _one_shot(variables, cfg, prompt, 10)
+    eos = int(full[prompt.size + 3])  # forces a stop after 4 tokens
+    assert eos not in full[prompt.size:prompt.size + 3]
+    eng = ServingEngine(variables, cfg, capacity=1, max_len=MAX_LEN,
+                        prefill_chunk=4)
+    r0 = eng.submit(Request(prompt, 10, eos_id=eos))
+    r1 = eng.submit(Request(prompt, 2))  # waits for r0's slot
+    eng.run()
+    assert r0.state == "completed"
+    assert len(r0.tokens) == 4 and r0.tokens[-1] == eos
+    np.testing.assert_array_equal(r0.output(), full[:prompt.size + 4])
+    assert r1.state == "completed" and len(r1.tokens) == 2
+
+
+def test_decode_horizon_invariant():
+    """decode_horizon is pure host-overhead amortization: the emitted
+    streams (including EOS truncation mid-horizon) are identical for
+    every horizon, and still one-shot-exact."""
+    cfg, variables = _setup()
+    prompts = _prompts((5, 9, 3), seed=11)
+    budgets = [7, 4, 6]
+    full = _one_shot(variables, cfg, prompts[0], 10)
+    eos = int(full[prompts[0].size + 2])
+
+    def serve(horizon):
+        eng = ServingEngine(variables, cfg, capacity=2, max_len=MAX_LEN,
+                            prefill_chunk=4, decode_horizon=horizon)
+        reqs = [Request(prompts[0], 10, eos_id=eos)] + \
+            [Request(p, b) for p, b in zip(prompts[1:], budgets[1:])]
+        eng.submit(reqs[0])
+        eng.step()
+        for r in reqs[1:]:
+            eng.submit(r)
+        eng.run()
+        return [r.output() for r in reqs]
+
+    a, b = serve(1), serve(4)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    for y, p, n in zip(b[1:], prompts[1:], budgets[1:]):
+        np.testing.assert_array_equal(y, _one_shot(variables, cfg, p, n))
+
+
+def test_temperature_sampling_deterministic_and_in_range():
+    """Per-request sampling is a function of (seed, token index) only —
+    re-serving the same request reproduces the stream, independent of
+    co-batching."""
+    cfg, variables = _setup()
+    prompts = _prompts((5, 6), seed=7)
+
+    def serve(reqs, capacity):
+        eng = ServingEngine(variables, cfg, capacity=capacity,
+                            max_len=MAX_LEN, prefill_chunk=4)
+        for r in reqs:
+            eng.submit(r)
+        eng.run()
+        return [r.output() for r in reqs]
+
+    a = serve([Request(prompts[0], 6, temperature=0.8, seed=5),
+               Request(prompts[1], 6, temperature=1.2, seed=9)], 2)
+    b = serve([Request(prompts[0], 6, temperature=0.8, seed=5)], 1)
+    np.testing.assert_array_equal(a[0], b[0])
+    assert np.all((a[1] >= 0) & (a[1] < 256))
+
+
+def test_deadline_cancels_running_and_queued():
+    cfg, variables = _setup()
+    clock = VirtualClock()
+    prompts = _prompts((4, 4), seed=2)
+    eng = ServingEngine(variables, cfg, capacity=1, max_len=MAX_LEN,
+                        prefill_chunk=4, clock=clock)
+    # r0 runs but can never finish 20 tokens by t=1.0 (1s per step)
+    r0 = eng.submit(Request(prompts[0], 20, deadline=1.0))
+    # r1 is stuck behind r0 and expires in the queue
+    r1 = eng.submit(Request(prompts[1], 2, deadline=0.5))
+    steps = 0
+    while eng.step():
+        clock.advance(1.0)
+        steps += 1
+        assert steps < 50
+    assert r0.state == "cancelled"
+    assert 0 < len(r0.tokens) < 20  # partial stream delivered
+    assert r1.state == "cancelled" and r1.tokens == []
+    assert eng.pool.n_free == 1  # cancelled slots come back
+
+
+def test_explicit_cancellation():
+    cfg, variables = _setup()
+    prompts = _prompts((4, 4), seed=4)
+    eng = ServingEngine(variables, cfg, capacity=1, max_len=MAX_LEN,
+                        prefill_chunk=8)
+    r0 = eng.submit(Request(prompts[0], 20))
+    r1 = eng.submit(Request(prompts[1], 3))
+    eng.step()
+    assert eng.cancel(r0)   # running: retired at the next step boundary
+    eng.run()
+    assert r0.state == "cancelled"
+    assert r1.state == "completed"
+    assert not eng.cancel(r0)  # already retired
+
+
+def test_pool_full_rejects_with_queue_depth():
+    """Backpressure, not stalls: pool full -> queue; queue full ->
+    immediate RequestRejected carrying the queue depth."""
+    cfg, variables = _setup()
+    (prompt,) = _prompts((4,))
+    eng = ServingEngine(variables, cfg, capacity=1, max_len=MAX_LEN,
+                        prefill_chunk=8, max_queue=2)
+    eng.submit(Request(prompt, 4))
+    eng.step()  # occupy the slot
+    eng.submit(Request(prompt, 4))
+    eng.submit(Request(prompt, 4))  # queue now at max_queue=2
+    with pytest.raises(RequestRejected) as ei:
+        eng.submit(Request(prompt, 4))
+    assert ei.value.queue_depth == 2
+    assert ei.value.max_queue == 2
+    assert "queue depth 2/2" in str(ei.value)
+    assert eng.metrics.summary()["n_rejected"] == 1
+    eng.run()
+
+
+def test_submit_validates_slot_capacity():
+    cfg, variables = _setup()
+    (prompt,) = _prompts((40,))
+    eng = ServingEngine(variables, cfg, capacity=1, max_len=MAX_LEN,
+                        prefill_chunk=8)
+    with pytest.raises(ValueError, match="cache positions"):
+        eng.submit(Request(prompt, MAX_LEN))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        Request(prompt, 0)
+    # a chunk window that could cross the cache end is refused up front
+    # (an overrunning dynamic_update_slice start would CLAMP, silently
+    # corrupting near-max_len prompts)
+    with pytest.raises(ValueError, match="divide max_len"):
+        ServingEngine(variables, cfg, capacity=1, max_len=MAX_LEN,
+                      prefill_chunk=32)
+
+
+def test_prompt_filling_the_slot_is_exact():
+    """Boundary regression: a prompt whose final prefill chunk ends
+    exactly at the cache end (prompt + budget == max_len) stays
+    token-exact — no chunk window crosses max_len."""
+    cfg, variables = _setup()
+    (prompt,) = _prompts((MAX_LEN - 6,), seed=12)  # 42 tokens, 6 budget
+    eng = ServingEngine(variables, cfg, capacity=1, max_len=MAX_LEN,
+                        prefill_chunk=8)
+    r = eng.submit(Request(prompt, 6))
+    eng.run()
+    np.testing.assert_array_equal(
+        r.output(), _one_shot(variables, cfg, prompt, 6))
+
+
+def test_quantized_interop_matches_one_shot():
+    """int8 weights + int8 K/V slots serve through the engine and match
+    the equally-quantized one-shot path (models/quant.py interop)."""
+    from bluefog_tpu.models.quant import quantize_llama_params
+
+    cfg, variables = _setup()
+    qvars = quantize_llama_params(variables)
+    prompts = _prompts((5, 7), seed=6)
+    eng = ServingEngine(qvars, cfg, capacity=2, max_len=MAX_LEN,
+                        prefill_chunk=4, kv_quant="int8",
+                        weight_quant="int8")
+    reqs = [eng.submit(Request(p, 5)) for p in prompts]
+    eng.run()
+    for r, p in zip(reqs, prompts):
+        want = _one_shot(qvars, cfg, p, 5, kv_quant="int8",
+                         weight_quant="int8")
+        np.testing.assert_array_equal(r.output(), want)
+    with pytest.raises(ValueError, match="quantize_llama_params"):
+        ServingEngine(variables, cfg, capacity=1, max_len=MAX_LEN,
+                      weight_quant="int8")
+
+
+def test_kv_pool_alloc_free():
+    cfg, _ = _setup()
+    pool = SlotPool(cfg, capacity=3, max_len=16)
+    slots = [pool.alloc() for _ in range(3)]
+    assert sorted(slots) == [0, 1, 2]
+    assert pool.alloc() is None and pool.n_free == 0
+    assert pool.occupancy() == 1.0
+    pool.free(slots[1])
+    assert pool.n_free == 1
+    assert pool.alloc() == slots[1]  # freed slot comes back
+    pool.free(slots[0])
+    with pytest.raises(ValueError, match="not allocated"):
+        pool.free(slots[0])  # double free
+
+
+def test_scheduler_fifo_and_expiry():
+    class R:
+        def __init__(self, deadline=None):
+            self.deadline = deadline
+
+    s = FifoScheduler(max_queue=3)
+    a, b, c = R(), R(deadline=1.0), R()
+    for r in (a, b, c):
+        s.submit(r)
+    with pytest.raises(RequestRejected):
+        s.submit(R())
+    assert s.admit(now=2.0) is a      # FIFO
+    assert s.admit(now=2.0) is c      # b expired (deadline 1.0 < 2.0)
+    assert s.admit(now=2.0) is None
+
+
+def test_metrics_and_timeline_spans(tmp_path):
+    """TTFT/latency/occupancy land in the summary, and request
+    lifecycle spans (admission -> prefill -> decode -> retire) reach the
+    chrome://tracing file through the existing timeline writer."""
+    from bluefog_tpu import timeline
+
+    cfg, variables = _setup()
+    clock = VirtualClock()
+    path = str(tmp_path / "serve_tl")
+    timeline.start_timeline(path)
+    try:
+        eng = ServingEngine(variables, cfg, capacity=2, max_len=MAX_LEN,
+                            prefill_chunk=4, clock=clock)
+        reqs = [eng.submit(Request(p, 4))
+                for p in _prompts((5, 6), seed=8)]
+        while eng.step():
+            clock.advance(0.25)
+    finally:
+        timeline.stop_timeline()
+    m = eng.metrics.summary()
+    assert m["n_finished"] == 2
+    assert m["tokens_generated"] == 8
+    assert m["tokens_per_sec"] > 0
+    assert 0 < m["ttft_p50"] <= m["ttft_p99"]
+    assert 0 < m["latency_p50"] <= m["latency_p99"]
+    assert 0 < m["mean_slot_occupancy"] <= 1.0
+    events = json.load(open(path + "0.json"))
+    names = {e.get("name") for e in events}
+    for phase in ("admission", "prefill", "decode", "retire"):
+        assert phase in names, (phase, names)
+    tracks = {e.get("tid") for e in events}
+    for r in reqs:
+        assert f"request.{r.rid}" in tracks
+
+
+def test_no_recompiles_across_arrival_patterns():
+    """The continuous-batching invariant: serving different prompts,
+    lengths, budgets, and arrival orders reuses the SAME compiled
+    programs — shapes depend only on (capacity, max_len, chunk)."""
+    from bluefog_tpu.serving.engine import (_decode_step_prog,
+                                            _prefill_chunk_prog)
+
+    cfg, variables = _setup()
+    eng = ServingEngine(variables, cfg, capacity=2, max_len=MAX_LEN,
+                        prefill_chunk=4)
+    reqs = [eng.submit(Request(p, 3)) for p in _prompts((5, 9), seed=9)]
+    eng.run()
+    pre = _prefill_chunk_prog._cache_size()
+    dec = _decode_step_prog._cache_size()
+    reqs = [Request(p, b) for p, b in
+            zip(_prompts((11, 2, 7), seed=10), (4, 6, 2))]
+    eng.submit(reqs[0])
+    eng.step()
+    for r in reqs[1:]:
+        eng.submit(r)
+    eng.run()
+    assert _prefill_chunk_prog._cache_size() == pre
+    assert _decode_step_prog._cache_size() == dec
+    assert all(r.state == "completed" for r in reqs)
+
+
+def test_poisson_arrival_trace_is_deterministic():
+    from bluefog_tpu.benchutil import poisson_arrivals
+
+    a = poisson_arrivals(2.0, 16, seed=3)
+    b = poisson_arrivals(2.0, 16, seed=3)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (16,) and a[0] == 0.0
+    assert np.all(np.diff(a) >= 0)
+    assert not np.array_equal(a, poisson_arrivals(2.0, 16, seed=4))
+    with pytest.raises(ValueError, match="rate"):
+        poisson_arrivals(0.0, 4)
+
+
+@pytest.mark.slow
+def test_serving_bench_smoke(tmp_path):
+    """The Poisson-load bench runs end to end and reports both engines
+    (slow: out of tier-1 — the bench measures wall time)."""
+    import subprocess
+    import sys
+    import os
+
+    out = str(tmp_path / "bench.json")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks",
+                                      "serving_bench.py"),
+         "--num-requests", "6", "--rate", "4", "--capacity", "2",
+         "--max-len", "48", "--prompt-len", "3", "8",
+         "--new-tokens", "2", "6", "--dim", "64", "--layers", "2",
+         "--prefill-chunk", "4", "--out", out],
+        capture_output=True, text=True, timeout=600, env=env, cwd=repo)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.load(open(out))
+    for side in ("continuous", "static"):
+        assert rec[side]["tokens_per_sec"] > 0
+        assert rec[side]["ttft_p99"] >= rec[side]["ttft_p50"] >= 0

@@ -101,6 +101,21 @@ def test_win_put_partial_destinations(bf_ctx):
     bf.win_free("w_part")
 
 
+def test_win_fence_observes_scaled_self_value(bf_ctx):
+    """round-5 verdict item 7: win_fence blocks on the window VALUE as
+    well as the mailbox — after a fence, the self_weight rescale a
+    win_put applied to the local window tensor is observable."""
+    bf.set_topology(RingGraph(SIZE))
+    x = rank_tensor((2,))
+    bf.win_create(x, "w_fence", zero_init=True)
+    bf.win_put(x, "w_fence", self_weight=0.5)
+    bf.win_fence("w_fence")
+    win_value = np.asarray(bf_win_value("w_fence"))
+    for r in range(SIZE):
+        np.testing.assert_allclose(win_value[r], 0.5 * r)
+    bf.win_free("w_fence")
+
+
 def test_win_put_self_weight_scales_local(bf_ctx):
     """win_put's self_weight multiplies the local window tensor in place
     (reference mpi_ops.py:1161-1175 'In-place multiply')."""
