@@ -1,0 +1,216 @@
+"""Chaos benchmark: decentralized training under injected faults.
+
+Round-8 evidence for the resilience subsystem (ISSUE 3): the same
+guarded one-compiled-program train step survives a NaN burst, a rank
+death, and the subsequent heal + rollback, and the surviving ranks keep
+converging — measured, not asserted.
+
+Two parts, one JSON artifact (wire_quant_consensus_r05.json style):
+
+1. **Healed-mixing simulation** (pure numpy, no devices): kill ranks in
+   the one-peer exponential-2 schedule at n=32, heal, and trace the
+   survivors' consensus distance — the claim is the healed rounds stay
+   row-stochastic and contract at a rate comparable to the unbroken
+   schedule, while the UNHEALED schedule (a dead rank frozen but still
+   weighted) stalls above it.
+2. **End-to-end chaos run** (8 CPU 'ranks'): guarded atc training over
+   the one-peer schedule with a scripted FaultPlan — a 2-step NaN burst
+   on one rank, then a rank death — through ``run_resilient`` with
+   checkpointing, vs the same data with no faults and no guard.
+   Reported: final mean loss both sides, skip counts, rollbacks,
+   recompiles (must be 0 across the whole chaotic run), wall time.
+
+Run (CPU, no TPU): JAX_PLATFORMS=cpu python benchmarks/chaos_resilience.py
+"""
+
+import argparse
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+
+N = 8          # end-to-end world (the forced CPU device count)
+SIM_N = 32     # simulation-only world (pure numpy)
+
+
+def simulate(sim_rounds: int, dim: int, seed: int) -> dict:
+    """Part 1: healed vs unhealed consensus traces at n=32."""
+    from bluefog_tpu.resilience import (consensus_simulation, heal_spec,
+                                        is_row_stochastic)
+    from bluefog_tpu.topology import one_peer_dynamic_schedule
+
+    sched = one_peer_dynamic_schedule(SIM_N)
+    dead = np.zeros(SIM_N, bool)
+    dead[[3, 17]] = True
+    healed = [heal_spec(s, dead) for s in sched]
+    out = {
+        "n": SIM_N, "dead_ranks": [3, 17], "rounds": sim_rounds,
+        "dim": dim,
+        "healed_row_stochastic": all(is_row_stochastic(s)
+                                     for s in healed),
+    }
+    traces = {
+        "healthy": consensus_simulation(sched, sim_rounds, dim, seed),
+        "healed": consensus_simulation(healed, sim_rounds, dim, seed,
+                                       dead_mask=dead),
+        # unhealed: the dead ranks' stale values keep their weight —
+        # the failure mode healing exists to fix (live-rank consensus
+        # still measured against the live mean)
+        "unhealed": consensus_simulation(sched, sim_rounds, dim, seed,
+                                         dead_mask=dead),
+    }
+    for name, tr in traces.items():
+        out[name] = {
+            "consensus_at": {str(t): float(tr[t])
+                             for t in (0, sim_rounds // 4,
+                                       sim_rounds // 2, sim_rounds - 1)},
+            "floor_median_tail": float(np.median(tr[int(0.8 * len(tr)):])),
+        }
+    return out
+
+
+def chaos_run(steps: int, seed: int) -> dict:
+    """Part 2: guarded chaos training vs fault-free unguarded baseline."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    from bluefog_tpu import resilience as R
+    from bluefog_tpu.checkpoint import Checkpointer
+    from bluefog_tpu.optim import functional as F
+    from bluefog_tpu.topology import one_peer_dynamic_schedule
+
+    mesh = Mesh(np.array(jax.devices()[:N]), ("bf",))
+    sched = one_peer_dynamic_schedule(N)
+    dim, width = 16, 4
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(dim, width)
+    xs = rng.randn(64, N, 8, dim)
+    ys = xs @ w_true + 0.01 * rng.randn(64, N, 8, width)
+
+    def batch_fn(step):
+        return (xs[step % 64], ys[step % 64])
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    opt = optax.sgd(0.05, momentum=0.9)
+
+    def fresh_state():
+        params = F.rank_major({"w": jnp.zeros((dim, width))}, mesh)
+        opt_state = F.rank_major(opt.init({"w": jnp.zeros((dim, width))}),
+                                 mesh)
+        return params, opt_state
+
+    # fault script: transient NaN burst early, rank death mid-run
+    burst_at, death_at = max(2, steps // 8), max(4, steps // 3)
+    plan = R.FaultPlan(N, [
+        R.Fault(burst_at, 1, "nan", duration=2),
+        R.Fault(death_at, 2, "dead"),
+    ])
+
+    step_g = F.build_train_step(loss_fn, opt, mesh, comm_mode="atc",
+                                schedule=sched, guard=F.GuardConfig())
+    import tempfile
+
+    params, opt_state = fresh_state()
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory() as d:
+        ck = Checkpointer(d)
+        res = R.run_resilient(
+            step_g, params, opt_state, batch_fn, steps=steps,
+            checkpointer=ck, mesh=mesh, schedule=sched,
+            guard=F.GuardConfig(max_consecutive_bad=3, backoff_base=0.0),
+            fault_plan=plan, checkpoint_every=max(2, steps // 6),
+            sleep=lambda s: None)
+        ck.close()
+    chaos_s = time.monotonic() - t0
+    live = ~res.dead_mask
+
+    # fault-free unguarded baseline on the same data
+    step_u = F.build_train_step(loss_fn, opt, mesh, comm_mode="atc",
+                                schedule=sched)
+    params, opt_state = fresh_state()
+    t0 = time.monotonic()
+    loss = None
+    for s in range(steps):
+        params, opt_state, loss = step_u(params, opt_state, batch_fn(s),
+                                         jnp.int32(s))
+    base_s = time.monotonic() - t0
+    base_loss = np.asarray(loss)
+
+    chaos_live_loss = float(np.asarray(res.last_loss)[live].mean())
+    base_live_loss = float(base_loss[live].mean())
+    return {
+        "steps": steps,
+        "fault_plan": {"nan_burst": {"rank": 1, "step": burst_at,
+                                     "duration": 2},
+                       "rank_death": {"rank": 2, "step": death_at}},
+        "n_rollbacks": res.n_rollbacks,
+        "dead_ranks": [int(r) for r in np.nonzero(res.dead_mask)[0]],
+        "skips_per_rank": [int(v) for v in res.total_skips],
+        "recompiles": step_g.jitted._cache_size() - 1,
+        "events": [(e.kind, e.step) for e in res.events
+                   if e.kind != "skip"],
+        "final_loss_live_mean_chaos": chaos_live_loss,
+        "final_loss_live_mean_faultfree": base_live_loss,
+        "params_all_finite": bool(R.update_health(res.params).all()),
+        "wall_s_chaos": chaos_s,
+        "wall_s_faultfree": base_s,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--dim", type=int, default=256,
+                    help="payload width of the mixing simulation")
+    ap.add_argument("--sim-rounds", type=int, default=400)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="benchmarks/chaos_resilience_r08.json")
+    args = ap.parse_args()
+
+    sim = simulate(args.sim_rounds, args.dim, args.seed)
+    chaos = chaos_run(args.steps, args.seed)
+
+    checks = {
+        # healing keeps the surviving ranks contracting...
+        "healed_row_stochastic": bool(sim["healed_row_stochastic"]),
+        "healed_converges": sim["healed"]["floor_median_tail"] < 1e-6,
+        # ...where the unhealed schedule visibly stalls above it
+        "unhealed_stalls_above_healed": (
+            sim["unhealed"]["floor_median_tail"]
+            > 10 * max(sim["healed"]["floor_median_tail"], 1e-12)),
+        # the chaos run survived: recovered, healed, finished finite
+        "chaos_rolled_back": chaos["n_rollbacks"] >= 1,
+        "chaos_declared_death": chaos["dead_ranks"] == [2],
+        "chaos_zero_recompiles": chaos["recompiles"] == 0,
+        "chaos_params_finite": chaos["params_all_finite"],
+        # and the survivors' loss is in the same regime as fault-free
+        "chaos_loss_comparable": (
+            chaos["final_loss_live_mean_chaos"]
+            < 10 * max(chaos["final_loss_live_mean_faultfree"], 1e-9)),
+    }
+    for k, ok in checks.items():
+        print(f"[check] {k}: {'OK' if ok else 'FAILED'}")
+
+    out = {
+        "simulation": sim,
+        "chaos": chaos,
+        "checks": {k: bool(v) for k, v in checks.items()},
+    }
+    with open(args.out, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps({"checks": out["checks"]}))
+    return 0 if all(checks.values()) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

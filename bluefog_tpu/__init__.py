@@ -117,6 +117,7 @@ from bluefog_tpu.topology import (  # noqa: F401
     default_pod_schedule,
 )
 from bluefog_tpu import optim  # noqa: F401
+from bluefog_tpu import resilience  # noqa: F401
 from bluefog_tpu import data  # noqa: F401
 from bluefog_tpu.data import (  # noqa: F401
     DataLoader,

@@ -673,8 +673,9 @@ def win_fence(name: str):
     # fence that only drained the mailbox could return while the scaled
     # self value is still in flight (round-5 verdict item 7)
     win = _wm().window(name)
-    with ctx_mod._watchdog.watch(f"win_fence.{name}"):
-        jax.block_until_ready((win.value, win.mailbox))
+    ctx_mod.timed_wait(f"win_fence.{name}",
+                       lambda: jax.block_until_ready((win.value,
+                                                      win.mailbox)))
 
 
 def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:

@@ -31,7 +31,11 @@ import numpy as np
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bluefog_tpu.logging_util import get_logger
+
 __all__ = ["Checkpointer"]
+
+logger = get_logger()
 
 
 class Checkpointer:
@@ -122,11 +126,36 @@ class Checkpointer:
 
     def restore_latest(self, mesh: Optional[Mesh] = None,
                        like: Any = None) -> Any:
-        step = self.latest_step()
-        if step is None:
+        """Restore the newest *restorable* step.
+
+        A corrupt or partially-written latest step (truncated array file,
+        interrupted save without a commit marker orbax still lists) must
+        not kill an elastic restart when an older intact checkpoint
+        exists: restore errors fall back to the next-newest step with a
+        warning.  Caller-contract errors (the rank-axis mesh mismatch
+        from ``_leaf_spec``) are NOT corruption and re-raise immediately
+        — falling back would silently resume a mismatched world.  If no
+        step restores, the newest step's error is re-raised."""
+        steps = self.all_steps()
+        if not steps:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory}")
-        return self.restore(step, mesh, like=like)
+        first_error: Optional[Exception] = None
+        for step in reversed(steps):
+            try:
+                return self.restore(step, mesh, like=like)
+            except ValueError as exc:
+                if "rank axis" in str(exc):
+                    raise  # mesh mismatch: a caller error, not damage
+                error = exc
+            except Exception as exc:  # orbax surfaces many error types
+                error = exc
+            first_error = first_error or error
+            logger.warning(
+                "checkpoint step %d under %s is not restorable "
+                "(%s: %s); falling back to the next-newest step",
+                step, self.directory, type(error).__name__, error)
+        raise first_error
 
     def close(self):
         self._mgr.close()

@@ -17,6 +17,7 @@ __all__ = [
     "skip_negotiate_default",
     "ops_on_cpu",
     "stall_warning_time",
+    "op_timeout",
     "fusion_threshold",
 ]
 
@@ -65,6 +66,19 @@ def stall_warning_time() -> float:
         return float(_env("BLUEFOG_STALL_WARNING_TIME", "60"))
     except ValueError:
         return 60.0
+
+
+def op_timeout() -> float:
+    """BLUEFOG_OP_TIMEOUT (seconds, default 0; <=0 disables) — hard ceiling
+    on any blocking wait (synchronize/barrier/win_wait/win_fence).  Where
+    the stall watchdog only *warns* (BLUEFOG_STALL_WARNING_TIME), this
+    RAISES ``BluefogError`` naming the stalled op and the stale processes
+    from the heartbeat beacons, so a wedged collective fails fast instead
+    of hanging the job forever."""
+    try:
+        return float(_env("BLUEFOG_OP_TIMEOUT", "0"))
+    except ValueError:
+        return 0.0
 
 
 def ops_on_cpu() -> bool:

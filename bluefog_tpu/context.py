@@ -242,6 +242,59 @@ class StallWatchdog:
 _watchdog = StallWatchdog()
 
 
+def timed_wait(name: str, wait_fn: Callable[[], Any]):
+    """Run a blocking wait under the stall watchdog AND the hard op
+    timeout (BLUEFOG_OP_TIMEOUT).
+
+    With the timeout disabled (the default) this is exactly the old
+    behavior: ``wait_fn()`` under a watchdog registration — stalls only
+    warn.  With a timeout set, the wait runs on a helper thread; if it
+    has not completed within the budget, a :class:`BluefogError` is
+    raised naming the op and the stale processes the heartbeat beacons
+    attribute the hang to (reference operations.cc:388-433 names the
+    waited-on ranks; the reference then keeps waiting — this escalates).
+    The helper thread cannot be interrupted and is leaked as a daemon;
+    the caller is expected to tear the job down (the point of a hard
+    timeout is to turn a silent hang into a crash an orchestrator can
+    restart)."""
+    timeout = bfconfig.op_timeout()
+    if timeout <= 0:
+        with _watchdog.watch(name):
+            return wait_fn()
+    box: Dict[str, Any] = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["value"] = wait_fn()
+        except BaseException as exc:  # noqa: BLE001 - relayed to caller
+            box["error"] = exc
+        finally:
+            done.set()
+
+    thread = threading.Thread(target=run, daemon=True,
+                              name=f"bf-wait-{name}")
+    with _watchdog.watch(name):
+        thread.start()
+        finished = done.wait(timeout)
+    if not finished:
+        # 0.7x margin mirrors the watchdog's stale attribution window
+        stale = _heartbeat.stale_processes(timeout * 0.7)
+        if stale:
+            raise BluefogError(
+                f"Operation '{name}' exceeded BLUEFOG_OP_TIMEOUT="
+                f"{timeout:g} s; liveness heartbeats report stale/absent "
+                f"process(es) {stale} — they are presumed dead or wedged.")
+        raise BluefogError(
+            f"Operation '{name}' exceeded BLUEFOG_OP_TIMEOUT={timeout:g} s "
+            "with no stale heartbeat detected — the device queue itself "
+            "may be wedged (or this is a single-process job, where "
+            "liveness cannot be attributed).")
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
 def host_fetch(array) -> np.ndarray:
     """Materialize a (possibly multi-host-sharded) array on this host.
 
@@ -592,8 +645,7 @@ class BluefogContext:
             key, value = self._handle_map.pop(handle)
             self._inflight_names.discard(key)
         try:
-            with _watchdog.watch(key):
-                return jax.block_until_ready(value)
+            return timed_wait(key, lambda: jax.block_until_ready(value))
         finally:
             # close spans even when the collective fails (a dead peer
             # raises here) — the trace must stay B/E-balanced precisely
@@ -619,8 +671,7 @@ class BluefogContext:
         Reference: mpi_controller.cc:1185 / mpi_ops.py:1002-1005."""
         token = self.run_op(("barrier",), lambda x: C.allreduce(x, AXIS, False),
                             np.zeros((self._size, 1), np.int32))
-        with _watchdog.watch("barrier"):
-            jax.block_until_ready(token)
+        timed_wait("barrier", lambda: jax.block_until_ready(token))
 
     # ------------------------------------------------------------------ #
     # weight resolution for neighbor ops

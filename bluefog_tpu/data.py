@@ -33,8 +33,12 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from bluefog_tpu.logging_util import get_logger
+
 __all__ = ["DistributedSampler", "DataLoader", "device_prefetch",
            "load_mnist", "load_cifar10"]
+
+logger = get_logger()
 
 
 class DistributedSampler:
@@ -192,6 +196,8 @@ class _PythonPipeline:
     """Fallback gather engine: one producer thread, same batch semantics
     and bit-identical output to the native pipeline."""
 
+    _join_timeout = 10.0  # seconds a shutdown waits for the producer
+
     def __init__(self, fields: List[np.ndarray], batch_size: int,
                  depth: int = 3, workers: int = 1):
         del workers
@@ -201,9 +207,12 @@ class _PythonPipeline:
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._thread: Optional[threading.Thread] = None
         self._cancel = threading.Event()
+        self._closed = False
 
     def start_epoch(self, order) -> int:
         self._drain()
+        self._closed = False  # reuse after close(): re-arm the latch so
+        # the NEXT close still drains the fresh producer
         order = np.ascontiguousarray(order, dtype=np.int64)
         n_batches = -(-len(order) // self._batch)
         self._cancel = threading.Event()
@@ -218,7 +227,8 @@ class _PythonPipeline:
                              for f in self._fields])
             self._q.put(None)
 
-        self._thread = threading.Thread(target=produce, daemon=True)
+        self._thread = threading.Thread(target=produce, daemon=True,
+                                        name="bf-data-producer")
         self._thread.start()
         return n_batches
 
@@ -232,14 +242,29 @@ class _PythonPipeline:
         del slot
 
     def _drain(self):
-        if self._thread is not None and self._thread.is_alive():
+        thread = self._thread
+        if thread is not None and thread.is_alive():
             self._cancel.set()
             while True:  # unblock a producer stuck on a full queue
                 try:
                     self._q.get_nowait()
                 except queue.Empty:
                     break
-            self._thread.join(timeout=10)
+            thread.join(timeout=self._join_timeout)
+            if thread.is_alive():
+                # A producer that survives cancel + queue drain + join is
+                # wedged in user code (e.g. a transform touching a dead
+                # filesystem).  It is a daemon, so it cannot block process
+                # exit — but it IS a leak, and silently ignoring it hides
+                # the resource bug.  Name it so the log points at the
+                # culprit.
+                logger.warning(
+                    "data prefetch shutdown: producer thread '%s' is "
+                    "still alive after %.0f s (cancel + queue drain + "
+                    "join); leaking it as a daemon. The producer is "
+                    "stuck outside the queue protocol — check the "
+                    "fields/transform it reads.",
+                    thread.name, self._join_timeout)
         while True:
             try:
                 self._q.get_nowait()
@@ -247,6 +272,12 @@ class _PythonPipeline:
                 break
 
     def close(self):
+        """Shut the producer down.  Idempotent: a second close() (e.g.
+        explicit close followed by __del__) is a no-op — in particular it
+        does not re-log the leak warning."""
+        if self._closed:
+            return
+        self._closed = True
         self._drain()
 
 

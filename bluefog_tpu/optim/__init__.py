@@ -18,7 +18,9 @@ from bluefog_tpu.optim.wrappers import (  # noqa: F401
     DistributedPushSumOptimizer,
 )
 from bluefog_tpu.optim.functional import (  # noqa: F401
+    GuardConfig,
     build_train_step,
+    comm_weight_inputs,
     consensus_distance,
     rank_major,
     rank_spec_tree,

@@ -30,6 +30,7 @@ Combine math is f32-accumulated via the shard-level kernels in
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 from typing import Any, Callable, Optional, Sequence, Union
 
@@ -47,7 +48,9 @@ from bluefog_tpu.topology.spec import DynamicTopology, Topology
 CommSpec = Union[Topology, DynamicTopology]
 
 __all__ = [
+    "GuardConfig",
     "build_train_step",
+    "comm_weight_inputs",
     "push_sum_weights",
     "rank_major",
     "rank_major_init",
@@ -55,6 +58,97 @@ __all__ = [
     "optax_state_specs",
     "consensus_distance",
 ]
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardConfig:
+    """Fault-tolerance policy for :func:`build_train_step`.
+
+    Only the PRESENCE of a GuardConfig changes the compiled program (the
+    non-finite skip guard + skip-flag output + traced combine weights);
+    the fields below are host-side policy consumed by
+    :func:`bluefog_tpu.resilience.run_resilient`:
+
+    * ``max_consecutive_bad`` — K: after this many consecutive steps
+      with a live-rank skip, the runner escalates — IF some rank was
+      bad for the whole window it is declared dead, the topology heals,
+      and the state rolls back to the last good checkpoint (an
+      unattributable window is noted and training continues: the skip
+      guard already contained it).
+    * ``backoff_base`` / ``backoff_factor`` / ``max_backoff`` — the
+      exponential backoff (seconds) slept before resuming after each
+      rollback: ``min(base * factor**i, max_backoff)``.
+    * ``max_rollbacks`` — give up (raise) after this many rollbacks.
+    """
+
+    max_consecutive_bad: int = 3
+    backoff_base: float = 0.5
+    backoff_factor: float = 2.0
+    max_backoff: float = 30.0
+    max_rollbacks: int = 8
+
+
+def comm_weight_inputs(specs: Sequence[CommSpec]) -> tuple:
+    """The combine weights of a topology/schedule as TRACED-OPERAND data:
+    one ``(class_weights [n_classes, n], self_weights [n])`` pair per
+    round, the pytree a guarded train step takes as its ``comm_weights``
+    argument.  Healing a topology (``resilience.healing``) produces a
+    pytree of the SAME shapes over the same edge structure, so swapping
+    weights never recompiles — the shape-stability contract of the
+    resilience layer."""
+    return tuple(
+        (C.class_recv_weights(s), C.self_weight_vector(s)) for s in specs)
+
+
+def _all_finite(loss: jax.Array, updates: Any) -> jax.Array:
+    """Scalar health bit: loss and every inexact update leaf finite —
+    the in-graph ``jnp.isfinite`` reduce the failure detector and the
+    skip guard share."""
+    ok = jnp.all(jnp.isfinite(loss))
+    for leaf in jax.tree.leaves(updates):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            ok = ok & jnp.all(jnp.isfinite(leaf))
+    return ok
+
+
+def _weighted_combine_fn(spec: CommSpec, axis_name: str,
+                         compress: Optional[str],
+                         n_buckets: Optional[int]) -> Callable:
+    """Combine branch ``fn(tree, key, (class_w, self_w))`` with the
+    weights as traced operands — ``spec`` contributes only the edge
+    structure (same design as windows.py's put/update kernels).  With
+    ``n_buckets`` the bucketed overlap packing is applied around the
+    weighted combine."""
+    wire = compress == "int8_sr"
+    wire_compress = "int8" if wire else compress
+
+    def fn(tree, key, w):
+        cw, sw = w
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if not leaves:
+            return tree
+        if n_buckets is None:
+            outs = [
+                C.neighbor_allreduce(
+                    p, spec, axis_name, compress=wire_compress,
+                    wire_key=(jax.random.fold_in(key, i) if wire
+                              else None),
+                    class_weights=cw, self_weights=sw)
+                for i, p in enumerate(leaves)
+            ]
+            return jax.tree_util.tree_unflatten(treedef, outs)
+        groups = _bucket_groups(leaves, n_buckets)
+        buffers = [_pack_bucket(leaves, g) for g in groups]
+        combined = C.neighbor_allreduce_buckets(
+            buffers, spec, axis_name, compress=wire_compress,
+            wire_key=key if wire else None,
+            class_weights=cw, self_weights=sw)
+        outs = [None] * len(leaves)
+        for g, buf in zip(groups, combined):
+            _unpack_bucket(buf, leaves, g, outs)
+        return jax.tree_util.tree_unflatten(treedef, outs)
+
+    return fn
 
 
 def rank_major(tree, mesh: Mesh, axis_name: str = "bf", specs=None):
@@ -349,6 +443,7 @@ def build_train_step(
     compress: Optional[str] = None,
     overlap: str = "none",
     overlap_buckets: int = 4,
+    guard: Optional[GuardConfig] = None,
 ) -> Callable:
     """Compile one decentralized SGD/optax step over ``mesh``.
 
@@ -410,9 +505,34 @@ def build_train_step(
     ``compress="int8*"``, where the absmax scale becomes per-bucket.
     ``compress=`` and dynamic ``schedule=`` plumb through unchanged.
 
+    ``guard=GuardConfig(...)`` compiles the RESILIENT variant of the
+    step (the jitted half of ``bluefog_tpu.resilience``):
+
+    * the optax apply is wrapped in a per-rank ``lax.cond`` on an
+      in-graph ``jnp.isfinite`` health check over (loss, updates) — a
+      rank whose step is non-finite SKIPS it (params, aux, and
+      opt_state all keep their previous finite values) and contributes
+      its pre-update params to the neighbor combine, so one poisoned
+      rank never contaminates its neighbors; the returned per-rank
+      ``skipped`` flags are the skip counter's per-step increments;
+    * the cta/atc combine weights become a TRACED INPUT (the
+      ``comm_weights`` pytree from :func:`comm_weight_inputs`, default
+      exposed as ``train_step.default_comm_weights``): topology healing
+      after a rank death swaps in new weight DATA over the same edge
+      structure — shapes never change, nothing recompiles.
+
+    With no faults present the guarded step's (params, opt_state,
+    loss) are bit-identical to the unguarded step's.  Not supported
+    with ``comm_mode='push_sum'`` (the (x, w) pair must mix as a unit)
+    or ``hierarchical_local_size`` (weights there are machine-level).
+
     Returns ``train_step(params, opt_state, batch, step) ->
     (params, opt_state, loss)`` — all rank-major, jit-compiled with
-    params/opt_state donated.
+    params/opt_state donated.  Under ``guard=`` the signature is
+    ``train_step(params, opt_state, batch, step, comm_weights) ->
+    (params, opt_state, loss, skipped)`` with ``skipped`` a rank-major
+    ``[n]`` int32 vector of this step's skip flags (``comm_weights`` is
+    ``()`` for comm modes without neighbor weights).
     """
     if comm_mode not in ("cta", "atc", "gradient_allreduce", "push_sum",
                          "none"):
@@ -440,6 +560,18 @@ def build_train_step(
                 f"{hierarchical_local_size!r})")
     if overlap not in ("none", "bucketed"):
         raise ValueError(f"unknown overlap mode {overlap!r}")
+    if guard is not None:
+        if comm_mode == "push_sum":
+            raise ValueError(
+                "guard= does not compose with comm_mode='push_sum': the "
+                "(params, ps_weight) pair must mix as a unit, and a "
+                "per-rank skip would break the column-stochastic "
+                "sum(ps) == n invariant")
+        if hierarchical_local_size is not None:
+            raise ValueError(
+                "guard= requires hierarchical_local_size=None (healing "
+                "delivers rank-level weight data; the hierarchical "
+                "combine takes machine-level weights)")
     if overlap == "bucketed":
         if comm_mode not in ("cta", "atc"):
             raise ValueError(
@@ -455,6 +587,15 @@ def build_train_step(
 
     specs = list(schedule) if schedule is not None else (
         [topology] if topology is not None else [])
+    if guard is not None:
+        return _build_guarded_train_step(
+            loss_fn, optimizer, mesh, guard=guard, axis_name=axis_name,
+            comm_mode=comm_mode, specs=specs,
+            num_steps_per_communication=num_steps_per_communication,
+            sp_axis=sp_axis, pp_axis=pp_axis, batch_specs=batch_specs,
+            param_specs=param_specs, opt_state_specs=opt_state_specs,
+            donate=donate, has_aux=has_aux, compress=compress,
+            n_buckets=overlap_buckets if bucketed else None)
     if bucketed and comm_mode == "cta":
         branches = [
             _bucketed_combine_fn(s, axis_name, hierarchical_local_size,
@@ -643,4 +784,169 @@ def build_train_step(
     no_aux_step.jitted = jitted
     no_aux_step.lower = lambda params, opt_state, batch, step: jitted.lower(
         params, (), opt_state, batch, step)
+    return no_aux_step
+
+
+def _build_guarded_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    guard: GuardConfig,
+    axis_name: str,
+    comm_mode: str,
+    specs: Sequence[CommSpec],
+    num_steps_per_communication: int,
+    sp_axis: Optional[str],
+    pp_axis: Optional[str],
+    batch_specs: Any,
+    param_specs: Any,
+    opt_state_specs: Any,
+    donate: bool,
+    has_aux: bool,
+    compress: Optional[str],
+    n_buckets: Optional[int],
+) -> Callable:
+    """The ``guard=`` variant of :func:`build_train_step` (see its
+    docstring for the contract).  Kept separate so the unguarded fast
+    path stays byte-for-byte what it was; numerics are identical when
+    every rank is healthy — the skip guard's taken branch IS the
+    unguarded arithmetic, and the traced combine weights carry the same
+    values the unguarded branches bake in."""
+    k_comm = int(num_steps_per_communication)
+    neighbor = comm_mode in ("cta", "atc")
+    wbranches = [
+        _weighted_combine_fn(s, axis_name, compress, n_buckets)
+        for s in specs
+    ] if neighbor else []
+
+    def combine(params, step, comm_weights):
+        if not wbranches:
+            return params
+
+        def run(params):
+            key = jax.random.fold_in(jax.random.PRNGKey(0x51EED), step)
+            if len(wbranches) == 1:
+                return wbranches[0](params, key, comm_weights[0])
+            picked = [
+                (lambda fn, i: lambda p, k, ws: fn(p, k, ws[i]))(fn, i)
+                for i, fn in enumerate(wbranches)
+            ]
+            return lax.switch(step % len(wbranches), picked, params, key,
+                              comm_weights)
+
+        if k_comm > 1:
+            return lax.cond(step % k_comm == 0, run, lambda p: p, params)
+        return run(params)
+
+    def per_rank_step(params, aux, opt_state, batch, step, comm_weights):
+        if has_aux:
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, aux, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            new_aux = aux
+        if sp_axis is not None:
+            grads = lax.pmean(grads, sp_axis)
+            loss = lax.pmean(loss, sp_axis)
+        if pp_axis is not None:
+            loss = lax.psum(loss, pp_axis)
+
+            def _pp_reduce(g, spec):
+                names = set()
+                for el in spec:
+                    if isinstance(el, tuple):
+                        names.update(el)
+                    elif el is not None:
+                        names.add(el)
+                return g if pp_axis in names else lax.psum(g, pp_axis)
+
+            grads = jax.tree.map(_pp_reduce, grads, param_specs)
+        if comm_mode == "gradient_allreduce":
+            # NOTE: the allreduce mixes GRADIENTS, so one rank's NaN
+            # reaches every rank's update — the guard then skips
+            # globally (all ranks keep their state).  The neighbor
+            # modes contain the blast radius to the faulty rank.
+            grads = jax.tree.map(
+                lambda g: C.allreduce(g, axis_name, average=True), grads)
+        if comm_mode == "cta":
+            params = combine(params, step, comm_weights)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        ok = _all_finite(loss, updates)
+
+        # The skip guard: a per-rank conditional over pure arithmetic
+        # only — the collective combine stays OUTSIDE (a per-rank-
+        # divergent branch must never contain a collective).  The
+        # skipping rank keeps params/aux/opt_state, so the combine
+        # below feeds its last-good params to its neighbors.  Lowered
+        # as an elementwise select over the unconditionally-applied
+        # update rather than a lax.cond: a traced-pred cond becomes a
+        # select anyway, but the cond's branch boundary would also
+        # block XLA's mul+add contraction inside apply_updates and cost
+        # the healthy path its bit-identity with the unguarded step.
+        # A discarded non-finite branch is safe under select: it is
+        # elementwise, and nothing differentiates through it here.
+        def pick(new, old):
+            return jnp.where(ok, new, old)
+
+        params = jax.tree.map(pick, optax.apply_updates(params, updates),
+                              params)
+        out_aux = jax.tree.map(pick, new_aux, aux)
+        out_opt = jax.tree.map(pick, new_opt_state, opt_state)
+        if comm_mode == "atc":
+            params = combine(params, step, comm_weights)
+        skipped = jnp.where(ok, jnp.int32(0), jnp.int32(1))
+        return params, out_aux, out_opt, loss, skipped
+
+    squeeze = lambda t: jax.tree.map(lambda x: x[0], t)
+    expand = lambda t: jax.tree.map(lambda x: x[None], t)
+
+    def wrapped(params, aux, opt_state, batch, step, comm_weights):
+        params, aux, opt_state, loss, skipped = per_rank_step(
+            squeeze(params), squeeze(aux), squeeze(opt_state),
+            squeeze(batch), step, comm_weights)
+        return (expand(params), expand(aux), expand(opt_state),
+                jnp.reshape(loss, (1,)), jnp.reshape(skipped, (1,)))
+
+    p_rank = P(axis_name)
+    if batch_specs is None:
+        batch_specs = p_rank
+    p_params = param_specs if param_specs is not None else p_rank
+    p_opt = opt_state_specs if opt_state_specs is not None else p_rank
+    # comm weights ride replicated (every rank reads the full tables)
+    p_comm = tuple((P(), P()) for _ in wbranches)
+    sm = jax.shard_map(
+        wrapped,
+        mesh=mesh,
+        in_specs=(p_params, p_rank, p_opt, batch_specs, P(), p_comm),
+        out_specs=(p_params, p_rank, p_opt, p_rank, p_rank),
+        check_vma=False,
+    )
+    donate_argnums = (0, 1, 2) if donate else ()
+    jitted = jax.jit(sm, donate_argnums=donate_argnums)
+    default_w = comm_weight_inputs(specs) if wbranches else ()
+
+    if has_aux:
+        def aux_step(params, aux, opt_state, batch, step, comm_weights):
+            return jitted(params, aux, opt_state, batch, step,
+                          comm_weights)
+
+        aux_step.jitted = jitted
+        aux_step.default_comm_weights = default_w
+        aux_step.has_aux = True  # run_resilient rejects aux signatures
+        aux_step.guard_config = guard
+        return aux_step
+
+    def no_aux_step(params, opt_state, batch, step, comm_weights):
+        params, _, opt_state, loss, skipped = jitted(
+            params, (), opt_state, batch, step, comm_weights)
+        return params, opt_state, loss, skipped
+
+    no_aux_step.jitted = jitted
+    no_aux_step.lower = (
+        lambda params, opt_state, batch, step, comm_weights:
+        jitted.lower(params, (), opt_state, batch, step, comm_weights))
+    no_aux_step.default_comm_weights = default_w
+    no_aux_step.has_aux = False
+    no_aux_step.guard_config = guard
     return no_aux_step

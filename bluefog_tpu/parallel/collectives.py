@@ -35,7 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from bluefog_tpu.topology.spec import DynamicTopology, Topology
+from bluefog_tpu.topology.spec import (DynamicTopology, Topology,
+                                       self_weights_of as _self_weights_of)
 
 CommSpec = Union[Topology, DynamicTopology]
 
@@ -67,12 +68,6 @@ def _accum_dtype(dtype) -> jnp.dtype:
     if jnp.issubdtype(dtype, jnp.integer) or dtype == jnp.dtype(bool):
         return jnp.dtype(jnp.float32)
     return dtype
-
-
-def _self_weights_of(spec: CommSpec) -> Sequence[float]:
-    if isinstance(spec, Topology):
-        return spec.self_weights
-    return spec.self_weight_values
 
 
 _structure_cache: dict = {}
@@ -334,6 +329,8 @@ def neighbor_allreduce_buckets(
     compress: Optional[str] = None,
     wire_key: Optional[jax.Array] = None,
     hierarchical_local_size: Optional[int] = None,
+    class_weights: Optional[jax.Array] = None,
+    self_weights: Optional[jax.Array] = None,
 ) -> list:
     """One weighted neighbor combine per bucket buffer — the data plane
     of the jitted overlap engine (``build_train_step(overlap=
@@ -351,7 +348,10 @@ def neighbor_allreduce_buckets(
     ``wire_key`` (with ``compress="int8"``) is folded with the BUCKET
     index so every bucket draws independent stochastic-rounding noise;
     ``hierarchical_local_size`` routes buckets through the machine-level
-    combine instead.  Numerics per element are identical to the per-leaf
+    combine instead.  ``class_weights``/``self_weights`` (flat path
+    only) supply the combine weights as TRACED OPERANDS shared by every
+    bucket — the resilience layer's topology-healing delivery, same
+    contract as ``neighbor_allreduce``.  Numerics per element are identical to the per-leaf
     ``neighbor_allreduce`` (the weighted combine distributes over
     concatenation) except for int8's per-TENSOR absmax scale, which under
     bucketing is per-BUCKET.
@@ -365,7 +365,8 @@ def neighbor_allreduce_buckets(
         key = (jax.random.fold_in(wire_key, i)
                if wire_key is not None else None)
         outs.append(neighbor_allreduce(
-            buf, spec, axis_name, compress=compress, wire_key=key))
+            buf, spec, axis_name, compress=compress, wire_key=key,
+            class_weights=class_weights, self_weights=self_weights))
     return outs
 
 

@@ -1,0 +1,73 @@
+"""Resilience subsystem: fault injection, failure detection, topology
+healing, and guarded-rollback training.
+
+The reference (and the paper) argue decentralized neighbor averaging
+tolerates imperfect communication; this package makes the TPU build
+actually survive it, in four shape-stable layers — faults change
+jitted-program *inputs*, never shapes, so nothing ever recompiles:
+
+* :mod:`~bluefog_tpu.resilience.faults` — deterministic fault plans
+  (NaN/Inf gradient bursts, rank death, host stalls) injected through
+  the batch, for tests and the chaos benchmark
+  (benchmarks/chaos_resilience.py);
+* :mod:`~bluefog_tpu.resilience.detector` — per-rank numeric health
+  from the guard's in-graph ``isfinite`` reduce + process liveness from
+  the heartbeat beacons;
+* :mod:`~bluefog_tpu.resilience.healing` — dead-rank excision as a
+  weight re-planning problem: row-stochasticity-preserving healed
+  weights delivered as traced DATA through the train step's existing
+  ``lax.switch`` schedule machinery;
+* :mod:`~bluefog_tpu.resilience.runner` — ``run_resilient``, the
+  skip -> detect -> heal -> rollback-with-backoff control loop over the
+  ``Checkpointer``.
+
+The jitted half lives in ``optim.functional``:
+``build_train_step(..., guard=GuardConfig(...))``.  Guide:
+docs/resilience.md.
+"""
+
+from bluefog_tpu.optim.functional import (  # noqa: F401
+    GuardConfig,
+    comm_weight_inputs,
+)
+from bluefog_tpu.resilience.faults import (  # noqa: F401
+    Fault,
+    FaultPlan,
+)
+from bluefog_tpu.resilience.detector import (  # noqa: F401
+    FailureDetector,
+    update_health,
+)
+from bluefog_tpu.resilience.healing import (  # noqa: F401
+    consensus_simulation,
+    heal_spec,
+    heal_weights,
+    healed_comm_weights,
+    is_row_stochastic,
+    mixing_matrix,
+    row_sums,
+)
+from bluefog_tpu.resilience.runner import (  # noqa: F401
+    ResilienceEvent,
+    ResilientResult,
+    run_resilient,
+)
+
+__all__ = [
+    "GuardConfig",
+    "comm_weight_inputs",
+    "Fault",
+    "FaultPlan",
+    "FailureDetector",
+    "update_health",
+    "consensus_simulation",
+    "heal_spec",
+    "heal_weights",
+    "healed_comm_weights",
+    "is_row_stochastic",
+    "mixing_matrix",
+    "row_sums",
+    "ResilienceEvent",
+    "ResilientResult",
+    "run_resilient",
+]

@@ -1,0 +1,175 @@
+"""Deterministic fault injection — the chaos harness.
+
+The paper's core claim is that decentralized neighbor averaging
+tolerates imperfect communication; proving the *system* tolerates it
+needs faults that are reproducible enough to assert exact outcomes
+against.  A :class:`FaultPlan` is a pure host-side schedule: at step S,
+rank r emits NaN/Inf gradients (a burst of ``duration`` steps), goes
+dead (emits garbage forever — the SPMD simulation of a lost device,
+whose slot keeps executing but whose contribution must be excluded), or
+stalls the host loop.
+
+Injection is SHAPE-STABLE by construction: faults enter the jitted
+train step only through its *inputs* (the batch rows of the faulted
+rank are poisoned host-side before ``device_put``), so a guarded step
+compiled once serves every fault pattern — the zero-recompile contract
+tests/test_resilience.py asserts the same way test_serving.py asserts
+compile counts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Fault", "FaultPlan", "NAN", "INF", "DEAD", "STALL"]
+
+NAN, INF, DEAD, STALL = "nan", "inf", "dead", "stall"
+_KINDS = (NAN, INF, DEAD, STALL)
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scheduled fault.
+
+    ``step``: first step the fault is active.  ``duration``: steps a
+    nan/inf burst lasts (ignored for ``dead``, which is permanent, and
+    for ``stall``, which fires once).  ``stall_seconds``: host-loop
+    sleep injected by a ``stall`` fault (exercises the watchdog / op
+    timeout, not the numerics)."""
+
+    step: int
+    rank: int
+    kind: str
+    duration: int = 1
+    stall_seconds: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"one of {_KINDS}")
+        if self.step < 0:
+            raise ValueError(f"fault step must be >= 0, got {self.step}")
+        if self.duration < 1:
+            raise ValueError(
+                f"fault duration must be >= 1, got {self.duration}")
+
+
+class FaultPlan:
+    """An immutable, deterministic schedule of faults over ``size`` ranks.
+
+    The plan answers two questions per step: which ranks' gradients are
+    corrupted (``corrupt_codes`` / ``corrupt_batch``) and how long the
+    host loop should stall (``stall_seconds``).  A ``dead`` rank is
+    modeled as a permanent NaN emitter from its death step on — the
+    in-process stand-in for a lost device: the guard skips it every
+    step, the detector's consecutive-skip count crosses the death
+    threshold, and healing excises it from the mixing matrix.
+    """
+
+    def __init__(self, size: int, faults: Sequence[Fault] = ()):
+        if size < 1:
+            raise ValueError(f"size must be >= 1, got {size}")
+        for f in faults:
+            if not 0 <= f.rank < size:
+                raise ValueError(
+                    f"fault rank {f.rank} outside world of size {size}")
+        self.size = size
+        self.faults: Tuple[Fault, ...] = tuple(
+            sorted(faults, key=lambda f: (f.step, f.rank)))
+
+    # ------------------------------------------------------------- #
+    # constructors for the common chaos scenarios
+    # ------------------------------------------------------------- #
+    @staticmethod
+    def healthy(size: int) -> "FaultPlan":
+        return FaultPlan(size, ())
+
+    @staticmethod
+    def nan_burst(size: int, rank: int, step: int,
+                  duration: int = 1) -> "FaultPlan":
+        return FaultPlan(size, [Fault(step, rank, NAN, duration)])
+
+    @staticmethod
+    def rank_death(size: int, rank: int, step: int) -> "FaultPlan":
+        return FaultPlan(size, [Fault(step, rank, DEAD)])
+
+    def merged(self, other: "FaultPlan") -> "FaultPlan":
+        if other.size != self.size:
+            raise ValueError("cannot merge plans over different sizes")
+        return FaultPlan(self.size, self.faults + other.faults)
+
+    # ------------------------------------------------------------- #
+    # queries
+    # ------------------------------------------------------------- #
+    def active(self, step: int) -> List[Fault]:
+        """Faults live at ``step`` (dead = live forever after onset)."""
+        out = []
+        for f in self.faults:
+            if f.kind == DEAD:
+                live = step >= f.step
+            elif f.kind == STALL:
+                live = step == f.step
+            else:
+                live = f.step <= step < f.step + f.duration
+            if live:
+                out.append(f)
+        return out
+
+    def corrupt_codes(self, step: int) -> np.ndarray:
+        """Per-rank corruption codes at ``step``: 0 healthy, 1 NaN,
+        2 Inf.  Dead ranks read as 1 (permanent NaN emitters)."""
+        codes = np.zeros((self.size,), np.int8)
+        for f in self.active(step):
+            if f.kind in (NAN, DEAD):
+                codes[f.rank] = 1
+            elif f.kind == INF:
+                codes[f.rank] = 2
+        return codes
+
+    def dead_ranks(self, step: int) -> List[int]:
+        return sorted({f.rank for f in self.faults
+                       if f.kind == DEAD and step >= f.step})
+
+    def stall_seconds(self, step: int) -> float:
+        return float(sum(f.stall_seconds for f in self.active(step)
+                         if f.kind == STALL))
+
+    def last_onset(self) -> int:
+        """The latest fault onset step (0 for an empty plan) — a chaos
+        run should train past this to observe recovery."""
+        return max((f.step for f in self.faults), default=0)
+
+    def corrupt_batch(self, batch: Any, step: int) -> Any:
+        """Poison the faulted ranks' rows of a HOST rank-major batch.
+
+        Every floating leaf must carry the ``[size, ...]`` leading rank
+        axis; faulted ranks' rows are overwritten with NaN/Inf, which the
+        backward pass turns into non-finite gradients on exactly those
+        ranks — faults become jitted-program *inputs*, never new shapes.
+        Healthy steps return ``batch`` unchanged (no copies)."""
+        import jax
+
+        codes = self.corrupt_codes(step)
+        if not codes.any():
+            return batch
+
+        def poison(leaf):
+            arr = np.asarray(leaf)
+            if not np.issubdtype(arr.dtype, np.floating):
+                return leaf
+            if arr.ndim < 1 or arr.shape[0] != self.size:
+                raise ValueError(
+                    f"corrupt_batch needs rank-major leaves with leading "
+                    f"dim {self.size}, got shape {arr.shape}")
+            arr = arr.copy()
+            arr[codes == 1] = np.nan
+            arr[codes == 2] = np.inf
+            return arr
+
+        return jax.tree.map(poison, batch)
+
+    def __repr__(self):
+        return f"FaultPlan(size={self.size}, faults={list(self.faults)})"

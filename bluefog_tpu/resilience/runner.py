@@ -1,0 +1,227 @@
+"""Guarded-rollback resilient training: the host-side control loop.
+
+``run_resilient`` drives a GUARDED train step (built with
+``build_train_step(..., guard=GuardConfig(...))``) through a fault
+environment:
+
+* every step's rank-major ``skipped`` flags feed the
+  :class:`~bluefog_tpu.resilience.detector.FailureDetector`;
+* transient faults cost exactly the faulty rank's skipped steps —
+  nothing else happens;
+* after K (= ``guard.max_consecutive_bad``) consecutive steps with a
+  LIVE-rank skip, the loop (1) declares the persistently-bad ranks dead,
+  (2) heals the mixing weights (``healing.healed_comm_weights`` — new
+  weight data, same compiled program), (3) rolls back to the last good
+  :class:`~bluefog_tpu.checkpoint.Checkpointer` state, and (4) sleeps an
+  exponential backoff before resuming;
+* checkpoints are taken every ``checkpoint_every`` steps, but only at
+  steps with no live-rank skip — rollback always lands on a state the
+  guard certified finite.
+
+Determinism contract: batches come from ``batch_fn(step)`` (a pure
+function of the step index), so replayed steps after a rollback see the
+SAME data — a run is reproducible fault plan included, which is what
+lets tests parity-check the rollback against the saved checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from bluefog_tpu.context import BluefogError
+from bluefog_tpu.optim.functional import GuardConfig
+from bluefog_tpu.resilience.detector import FailureDetector
+from bluefog_tpu.resilience.faults import FaultPlan
+from bluefog_tpu.resilience.healing import healed_comm_weights
+
+__all__ = ["ResilienceEvent", "ResilientResult", "run_resilient"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceEvent:
+    """One entry of the run's event log: ``kind`` in {"checkpoint",
+    "skip", "rank_dead", "rollback"}; ``step`` is the step index the
+    event fired at; ``detail`` carries kind-specific fields (rollback:
+    ``restored_step``, ``backoff``, ``dead``)."""
+
+    kind: str
+    step: int
+    detail: dict
+
+
+@dataclasses.dataclass
+class ResilientResult:
+    params: Any
+    opt_state: Any
+    step: int
+    last_loss: Optional[np.ndarray]
+    total_skips: np.ndarray       # [n] skips per rank, replays included
+    n_rollbacks: int
+    dead_mask: np.ndarray         # [n] bool
+    events: List[ResilienceEvent]
+
+
+def run_resilient(
+    train_step: Callable,
+    params: Any,
+    opt_state: Any,
+    batch_fn: Callable[[int], Any],
+    *,
+    steps: int,
+    checkpointer,
+    mesh,
+    axis_name: str = "bf",
+    guard: Optional[GuardConfig] = None,
+    schedule: Optional[Sequence] = None,
+    comm_weights: Optional[tuple] = None,
+    fault_plan: Optional[FaultPlan] = None,
+    detector: Optional[FailureDetector] = None,
+    checkpoint_every: int = 10,
+    sleep: Callable[[float], None] = time.sleep,
+    on_event: Optional[Callable[[ResilienceEvent], None]] = None,
+) -> ResilientResult:
+    """Train ``steps`` steps under faults; see the module docstring for
+    the recovery semantics.
+
+    ``train_step`` must be guard-built (it exposes
+    ``default_comm_weights`` and returns the ``skipped`` vector).
+    ``schedule`` is the list of topology specs backing the step's
+    combine (one element for a static topology) — required for healing;
+    without it a rollback restores state but the mixing weights stay as
+    passed.  ``checkpointer`` needs ``save(step, state, force=)`` and
+    ``restore_latest(mesh, like=)`` (the orbax ``Checkpointer``'s
+    surface); checkpoint steps store ``{"params", "opt_state", "step"}``.
+    ``sleep`` is injectable so tests (and the chaos bench) run backoff
+    under a virtual clock.
+    """
+    if not hasattr(train_step, "default_comm_weights"):
+        raise ValueError(
+            "run_resilient needs a GUARDED train step — build it with "
+            "build_train_step(..., guard=GuardConfig(...))")
+    if getattr(train_step, "has_aux", False):
+        raise ValueError(
+            "run_resilient drives the no-aux step signature "
+            "(params, opt_state, batch, step, comm_weights); a "
+            "has_aux=True guarded step takes an extra aux tree — drive "
+            "it with your own loop, or fold the aux state into params")
+    # policy default: the GuardConfig the step was BUILT with (attached
+    # by build_train_step) — passing guard= here only to repeat it
+    # would be a silent-drift trap
+    if guard is None:
+        guard = getattr(train_step, "guard_config", None) or GuardConfig()
+    n = int(mesh.shape[axis_name])
+    detector = detector or FailureDetector(n)
+    if comm_weights is None:
+        comm_weights = train_step.default_comm_weights
+    dead = detector.dead_mask()
+    if dead.any() and schedule:
+        comm_weights = healed_comm_weights(schedule, dead)
+
+    events: List[ResilienceEvent] = []
+
+    def emit(kind: str, step: int, **detail):
+        ev = ResilienceEvent(kind, step, detail)
+        events.append(ev)
+        if on_event is not None:
+            on_event(ev)
+
+    def save(step: int):
+        checkpointer.save(
+            step, {"params": params, "opt_state": opt_state,
+                   "step": step}, force=True)
+        emit("checkpoint", step)
+
+    like = {"params": params, "opt_state": opt_state, "step": 0}
+    total_skips = np.zeros(n, np.int64)
+    last_loss: Optional[np.ndarray] = None
+    consecutive_bad = 0
+    n_rollbacks = 0
+    step = 0
+    save(0)  # rollback anchor: the pristine initial state
+
+    while step < steps:
+        batch = batch_fn(step)
+        if fault_plan is not None:
+            stall = fault_plan.stall_seconds(step)
+            if stall > 0:
+                sleep(stall)  # straggler injection: the stall watchdog /
+                # BLUEFOG_OP_TIMEOUT layer owns this failure class
+            batch = fault_plan.corrupt_batch(batch, step)
+        params, opt_state, loss, skipped = train_step(
+            params, opt_state, batch, jnp.int32(step), comm_weights)
+        sk = np.asarray(skipped).reshape(-1) != 0
+        detector.observe(sk)
+        total_skips += sk
+        last_loss = np.asarray(loss)
+        live_bad = detector.live_bad(sk)
+        if live_bad:
+            # only LIVE-rank skips are events: a declared-dead rank
+            # skips every remaining step by design, and logging that
+            # forever would grow the event list linearly in run length
+            emit("skip", step, ranks=[int(r) for r in np.nonzero(sk)[0]])
+        consecutive_bad = consecutive_bad + 1 if live_bad else 0
+        step += 1
+
+        if consecutive_bad >= guard.max_consecutive_bad:
+            # Rollback is only useful when the badness is ATTRIBUTABLE:
+            # a rank bad for the whole window is declared dead and
+            # healed out, and restoring pre-poison state gives the
+            # survivors a clean trajectory.  A window of overlapping
+            # transients from DIFFERENT ranks has nothing to heal —
+            # the skip guard already contained every one of them, and
+            # a rollback would deterministically replay the identical
+            # transients (batch_fn and the fault environment are
+            # functions of the step index) in a futile loop.  Note the
+            # window and keep training instead.
+            newly = detector.suspects(guard.max_consecutive_bad)
+            if not newly:
+                emit("bad_window_unattributed", step,
+                     window=guard.max_consecutive_bad)
+                consecutive_bad = 0
+                continue
+            if n_rollbacks >= guard.max_rollbacks:
+                raise BluefogError(
+                    f"run_resilient: giving up after {n_rollbacks} "
+                    f"rollbacks (guard.max_rollbacks) with live ranks "
+                    "still failing — the fault is not survivable by "
+                    "skip/heal/rollback")
+            detector.declare_dead(newly)
+            dead = detector.dead_mask()
+            for r in newly:
+                emit("rank_dead", step, rank=r)
+            if dead.all():
+                raise BluefogError(
+                    "run_resilient: every rank has been declared "
+                    "dead — there is no surviving state to heal "
+                    "around; the job must be restarted")
+            if schedule:
+                comm_weights = healed_comm_weights(schedule, dead)
+            state = checkpointer.restore_latest(mesh, like=like)
+            params, opt_state = state["params"], state["opt_state"]
+            restored_step = int(state["step"])
+            backoff = min(
+                guard.backoff_base * guard.backoff_factor ** n_rollbacks,
+                guard.max_backoff)
+            n_rollbacks += 1
+            emit("rollback", step, restored_step=restored_step,
+                 backoff=backoff, dead=[int(r) for r in np.nonzero(dead)[0]])
+            step = restored_step
+            consecutive_bad = 0
+            detector.reset_streaks()
+            if backoff > 0:
+                sleep(backoff)
+            continue
+
+        if (checkpoint_every > 0 and step % checkpoint_every == 0
+                and not live_bad):
+            save(step)
+
+    return ResilientResult(
+        params=params, opt_state=opt_state, step=step, last_loss=last_loss,
+        total_skips=total_skips, n_rollbacks=n_rollbacks,
+        dead_mask=detector.dead_mask(), events=events)

@@ -294,7 +294,6 @@ class ServingEngine:
         self._params = variables["params"]
         self._running: Dict[int, Request] = {}   # slot -> request
         self._admitting: Optional[Request] = None  # mid-prefill request
-        self._requests: Dict[int, Request] = {}  # rid -> request
 
     # -- submission ---------------------------------------------------- #
     def submit(self, request: Request) -> Request:
@@ -303,6 +302,13 @@ class ServingEngine:
         request cannot fit a slot at all."""
         total = request.prompt.size + request.max_new_tokens
         if total > self.pool.max_len:
+            # refusal paths agree: a request the engine will never run
+            # is terminal (done == True) AND counted in n_rejected,
+            # whichever way it was refused — caller loops polling
+            # req.done must not wait on a phantom, and a dashboard
+            # must see every refusal
+            request.state = REJECTED
+            self.metrics.on_reject(request.rid, self.clock())
             raise ValueError(
                 f"request needs {total} cache positions but slots hold "
                 f"{self.pool.max_len} (prompt {request.prompt.size} + "
@@ -314,7 +320,6 @@ class ServingEngine:
             request.state = REJECTED
             self.metrics.on_reject(request.rid, now)
             raise
-        self._requests[request.rid] = request
         self.metrics.on_submit(request.rid, now)
         return request
 

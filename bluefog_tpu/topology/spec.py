@@ -23,7 +23,17 @@ import networkx as nx
 import numpy as np
 
 __all__ = ["ShiftClass", "Topology", "DynamicTopology",
-           "uniform_topology_spec"]
+           "self_weights_of", "uniform_topology_spec"]
+
+
+def self_weights_of(spec) -> Tuple[float, ...]:
+    """Per-rank self weights of either spec flavor (Topology keeps them
+    as ``self_weights``, DynamicTopology as ``self_weight_values``) —
+    the one accessor shared by the collectives and the resilience
+    healing planner."""
+    if isinstance(spec, Topology):
+        return spec.self_weights
+    return spec.self_weight_values
 
 
 def uniform_topology_spec(graph: nx.DiGraph) -> "Topology":

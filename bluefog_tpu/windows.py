@@ -171,14 +171,14 @@ class WindowManager:
             return handle
 
     def wait(self, handle: int) -> bool:
-        from bluefog_tpu.context import _watchdog
+        from bluefog_tpu.context import timed_wait
 
         with self._lock:
             entry = self._win_handle_map.pop(handle, None)
         if entry is None:
             return False
-        with _watchdog.watch(f"win.{entry[0]}"):
-            jax.block_until_ready(entry[1])
+        timed_wait(f"win.{entry[0]}",
+                   lambda: jax.block_until_ready(entry[1]))
         return True
 
     def poll(self, handle: int) -> bool:

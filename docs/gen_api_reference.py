@@ -35,6 +35,16 @@ MODULES = [
     ("bluefog_tpu.optim", "distributed optimizer wrappers (eager API)"),
     ("bluefog_tpu.optim.functional",
      "jitted whole-pytree train steps (SPMD API)"),
+    ("bluefog_tpu.resilience",
+     "resilience: fault injection, detection, healing, guarded rollback"),
+    ("bluefog_tpu.resilience.faults",
+     "deterministic fault-injection plans (the chaos harness)"),
+    ("bluefog_tpu.resilience.detector",
+     "failure detection: numeric health + liveness heartbeats"),
+    ("bluefog_tpu.resilience.healing",
+     "topology healing: dead-rank weight re-planning"),
+    ("bluefog_tpu.resilience.runner",
+     "run_resilient: the skip/heal/rollback control loop"),
     ("bluefog_tpu.models", "model zoo: Llama, ResNet, ViT, MNIST nets"),
     ("bluefog_tpu.models.llama", "Llama config/stack, TP/EP/vocab-parallel"),
     ("bluefog_tpu.models.generate", "K/V-cached autoregressive decode"),

@@ -86,6 +86,48 @@ def test_restore_latest_mismatched_world_errors(tmp_path):
     ckpt.close()
 
 
+def test_restore_latest_falls_back_past_corrupt_step(tmp_path):
+    """Elastic-restart robustness (extends the PR-2 error-path tests):
+    a truncated/partial latest step — the typical artifact of a save
+    interrupted by the very crash that forces the restart — must not
+    kill the resume when an older intact checkpoint exists.
+    restore_latest warns and returns the newest RESTORABLE step; with
+    every step damaged, the newest step's error propagates."""
+    import glob
+    import os
+
+    mesh = _mesh()
+    ckpt = ckpt_mod.Checkpointer(str(tmp_path / "c"))
+    for step in (1, 2):
+        ckpt.save(step, {"x": jax.device_put(
+            np.full((8, 2), float(step), np.float32),
+            NamedSharding(mesh, P("bf")))})
+
+    def truncate(step):
+        payloads = glob.glob(os.path.join(str(tmp_path / "c"), str(step),
+                                          "default", "**", "d", "*"),
+                             recursive=True)
+        assert payloads  # the orbax layout we expect to be damaging
+        for p in payloads:
+            with open(p, "r+b") as fh:
+                fh.truncate(10)
+
+    truncate(2)
+    restored = ckpt.restore_latest(mesh)
+    np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                  np.ones((8, 2)))  # step 1 survives
+    # a mesh-mismatch is a CALLER error, not corruption: it must raise
+    # the documented rank-axis ValueError, never fall back
+    small_mesh = Mesh(np.array(jax.devices()[:4]), ("bf",))
+    with pytest.raises(ValueError, match="rank axis"):
+        ckpt.restore_latest(small_mesh)
+    # nothing restorable left: the newest step's error propagates
+    truncate(1)
+    with pytest.raises(Exception, match="OUT_OF_RANGE|byte range|Error"):
+        ckpt.restore_latest(mesh)
+    ckpt.close()
+
+
 def test_restore_without_mesh_gives_host_arrays(tmp_path):
     mesh = _mesh()
     ckpt = ckpt_mod.Checkpointer(str(tmp_path / "c"))

@@ -102,6 +102,84 @@ def test_native_pipeline_multi_epoch_and_abandon():
     pipe.close()
 
 
+def test_python_pipeline_shutdown_leak_warns_and_close_idempotent():
+    """A producer thread that survives cancel + drain + join is a leak:
+    close() must say so (naming the thread) instead of silently
+    ignoring it, and a second close() is a no-op — no double shutdown,
+    no duplicate warning (the __del__-after-close path)."""
+    import logging
+    import threading
+    import time
+
+    from bluefog_tpu.data import _PythonPipeline
+    from bluefog_tpu.logging_util import get_logger
+
+    class Capture(logging.Handler):
+        def __init__(self):
+            super().__init__(level=logging.WARNING)
+            self.messages = []
+
+        def emit(self, record):
+            self.messages.append(record.getMessage())
+
+    handler = Capture()
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        x, y = _dataset(32)
+        pipe = _PythonPipeline([x, y], batch_size=8, depth=2)
+        # simulate a producer wedged outside the queue protocol (e.g. a
+        # transform stuck on a dead filesystem): a thread that ignores
+        # the cancel event entirely
+        stuck = threading.Thread(target=time.sleep, args=(30,),
+                                 daemon=True, name="bf-data-producer")
+        stuck.start()
+        pipe._thread = stuck
+        pipe._join_timeout = 0.05
+        pipe.close()
+        leaks = [m for m in handler.messages
+                 if "still alive" in m and "bf-data-producer" in m]
+        assert len(leaks) == 1, handler.messages
+        pipe.close()  # idempotent: no second warning, no error
+        assert len([m for m in handler.messages
+                    if "still alive" in m]) == 1
+    finally:
+        logger.removeHandler(handler)
+
+
+def test_python_pipeline_clean_shutdown_does_not_warn():
+    import logging
+
+    from bluefog_tpu.data import _PythonPipeline
+    from bluefog_tpu.logging_util import get_logger
+
+    class Capture(logging.Handler):
+        def __init__(self):
+            super().__init__(level=logging.WARNING)
+            self.messages = []
+
+        def emit(self, record):
+            self.messages.append(record.getMessage())
+
+    handler = Capture()
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        x, y = _dataset(32)
+        pipe = _PythonPipeline([x, y], batch_size=8, depth=2)
+        pipe.start_epoch(np.arange(32))  # abandon mid-epoch: the
+        pipe.close()                     # cancel protocol must suffice
+        # reuse after close re-arms the latch: the SECOND close must
+        # still drain the fresh producer (not be a latched no-op)
+        pipe.start_epoch(np.arange(32))
+        thread = pipe._thread
+        pipe.close()
+        assert thread is not None and not thread.is_alive()
+        assert not any("still alive" in m for m in handler.messages)
+    finally:
+        logger.removeHandler(handler)
+
+
 # ------------------------------------------------------------- DataLoader
 
 

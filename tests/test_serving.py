@@ -208,6 +208,40 @@ def test_deadline_cancels_running_and_queued():
     assert eng.pool.n_free == 1  # cancelled slots come back
 
 
+def test_deadline_cancels_mid_prefill():
+    """Deadline contract, prefill phase: a request whose deadline
+    expires while its prompt is still being chunk-prefilled (state
+    'prefill', not yet decoding) is cancelled at the next step
+    boundary with ZERO tokens delivered, its slot comes back, and the
+    request behind it serves to one-shot exactness through the
+    reclaimed slot."""
+    cfg, variables = _setup()
+    clock = VirtualClock()
+    long_prompt, short_prompt = _prompts((17, 4), seed=5)
+    # chunk=2 -> prompt[:-1] needs 8 chunks at 1 chunk/step: the
+    # deadline at t=2.5 lands mid-prefill (1 s per step)
+    eng = ServingEngine(variables, cfg, capacity=1, max_len=MAX_LEN,
+                        prefill_chunk=2, clock=clock)
+    r0 = eng.submit(Request(long_prompt, 8, deadline=2.5))
+    r1 = eng.submit(Request(short_prompt, 3))
+    saw_prefill = False
+    steps = 0
+    while eng.step():
+        saw_prefill = saw_prefill or r0.state == "prefill"
+        clock.advance(1.0)
+        steps += 1
+        assert steps < 50
+    assert saw_prefill                      # it WAS mid-prefill
+    assert r0.state == "cancelled"
+    assert r0.tokens == [] and r0.slot is None   # never reached decode
+    assert r1.state == "completed"
+    assert eng.pool.n_free == 1             # the slot came back
+    np.testing.assert_array_equal(
+        r1.output(), _one_shot(variables, cfg, short_prompt, 3))
+    m = eng.metrics.summary()
+    assert m["outcomes"].get("cancelled") == 1
+
+
 def test_explicit_cancellation():
     cfg, variables = _setup()
     prompts = _prompts((4, 4), seed=4)
@@ -248,8 +282,15 @@ def test_submit_validates_slot_capacity():
     (prompt,) = _prompts((40,))
     eng = ServingEngine(variables, cfg, capacity=1, max_len=MAX_LEN,
                         prefill_chunk=8)
+    big = Request(prompt, MAX_LEN)
     with pytest.raises(ValueError, match="cache positions"):
-        eng.submit(Request(prompt, MAX_LEN))
+        eng.submit(big)
+    # refusal paths agree: a request the engine will never run is
+    # terminal AND counted, same as the RequestRejected backpressure
+    # path — a caller polling req.done must not wait on a phantom, and
+    # a dashboard must see every refusal
+    assert big.state == "rejected" and big.done
+    assert eng.metrics.summary()["n_rejected"] == 1
     with pytest.raises(ValueError, match="max_new_tokens"):
         Request(prompt, 0)
     # a chunk window that could cross the cache end is refused up front

@@ -1,11 +1,12 @@
-"""Stall watchdog (reference operations.cc:388-433 parity)."""
+"""Stall watchdog (reference operations.cc:388-433 parity) and the
+hard-op-timeout escalation layered on it (BLUEFOG_OP_TIMEOUT)."""
 
 import logging
 import time
 
 import pytest
 
-from bluefog_tpu.context import StallWatchdog
+from bluefog_tpu.context import BluefogError, StallWatchdog, timed_wait
 from bluefog_tpu.logging_util import get_logger
 
 
@@ -54,6 +55,73 @@ def test_watchdog_disabled(monkeypatch, capture, watchdog):
     with watchdog.watch("op"):
         time.sleep(0.1)
     assert not any("Stall detected" in m for m in capture.messages)
+
+
+def test_op_timeout_disabled_by_default():
+    """BLUEFOG_OP_TIMEOUT unset: timed_wait is the plain watchdog-
+    wrapped wait — it blocks to completion and returns the value."""
+    assert timed_wait("slow_but_fine",
+                      lambda: (time.sleep(0.05), 41)[1]) == 41
+
+
+def test_op_timeout_raises_naming_the_op(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_OP_TIMEOUT", "0.2")
+    t0 = time.monotonic()
+    with pytest.raises(BluefogError) as ei:
+        timed_wait("allreduce.stuck_op", lambda: time.sleep(30))
+    assert time.monotonic() - t0 < 5  # escalated, did not block 30 s
+    msg = str(ei.value)
+    assert "allreduce.stuck_op" in msg
+    assert "BLUEFOG_OP_TIMEOUT" in msg
+
+
+def test_op_timeout_names_stale_ranks(monkeypatch):
+    """When the heartbeat beacons attribute the hang, the error names
+    the stale processes (the watchdog's attribution, escalated from a
+    warning to a raise)."""
+    from bluefog_tpu import context as ctx_mod
+
+    monkeypatch.setenv("BLUEFOG_OP_TIMEOUT", "0.2")
+    monkeypatch.setattr(ctx_mod._heartbeat, "stale_processes",
+                        lambda threshold: [1, 3])
+    with pytest.raises(BluefogError, match=r"\[1, 3\]"):
+        timed_wait("neighbor_allreduce.orphaned", lambda: time.sleep(30))
+
+
+def test_op_timeout_fast_wait_returns_value(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_OP_TIMEOUT", "5")
+    assert timed_wait("fast", lambda: 7) == 7
+
+
+def test_op_timeout_propagates_wait_errors(monkeypatch):
+    """An error raised by the wait itself (e.g. a dead peer surfacing
+    through block_until_ready) must not be masked by the timeout
+    machinery."""
+    monkeypatch.setenv("BLUEFOG_OP_TIMEOUT", "5")
+
+    def boom():
+        raise RuntimeError("peer closed")
+
+    with pytest.raises(RuntimeError, match="peer closed"):
+        timed_wait("doomed", boom)
+
+
+def test_op_timeout_applies_to_eager_collectives(monkeypatch, bf_ctx):
+    """The escalation is wired into the real blocking path: a
+    synchronize whose device work never completes raises (simulated by
+    stubbing the block; a real wedged collective behaves identically)."""
+    import numpy as np
+    import jax as _jax
+
+    x = bf_ctx.from_rank_values(lambda r: np.full((4,), float(r)))
+    y = bf_ctx.neighbor_allreduce(x)  # completes fine under a timeout
+    assert np.asarray(bf_ctx.to_rank_values(y)).shape == (8, 4)
+    monkeypatch.setenv("BLUEFOG_OP_TIMEOUT", "0.2")
+    monkeypatch.setattr(_jax, "block_until_ready",
+                        lambda v: time.sleep(30))
+    handle = bf_ctx.neighbor_allreduce_nonblocking(x, name="wedged_op")
+    with pytest.raises(BluefogError, match="wedged_op"):
+        bf_ctx.synchronize(handle)
 
 
 def test_stalled_collective_names_the_stuck_rank(tmp_path):
