@@ -1,0 +1,227 @@
+"""Per-op accounting for large-batch decode (the round-5 VERDICT gap).
+
+Round 5 measured the large-batch regression — decode throughput FALLING
+from B=32 to B=64 at 200m on v5e (`decode_200m_v5e1_r05.json`:
+22.8% -> 13.4% of ceiling) — but shipped no per-op accounting at those
+batch sizes: the claim "the wall is compute or per-step overhead, not
+streaming" was asserted, not attributed.  This bench closes the gap
+with the observability subsystem's supported attribution path: ONE
+:func:`bluefog_tpu.observe.profile_step` call per batch size yields the
+compiled decode step's FLOPs, cost-analysis bytes, per-op breakdown,
+and (with measured step seconds) MFU/HBM utilization — so the B=32 vs
+B=64 comparison is a machine-checked table, not a narrative.
+
+What the attribution separates:
+
+* **per-token compute** — decode FLOPs scale ~linearly in B (every row
+  runs the same matmuls), so FLOPs/token should be FLAT across B; if
+  measured step time grows FASTER than FLOPs, the regression is not
+  arithmetic;
+* **per-token HBM traffic** — the weight stream is shared across the
+  batch, so bytes/token should FALL with B; if throughput still drops,
+  the wall is not streaming either (the round-5 hypothesis, now
+  checked);
+* what remains — step-time growth beyond both curves — is dispatch /
+  layout / MXU-latency overhead, quantified as ``overhead_share``.
+
+The emitted JSON (default ``benchmarks/decode_accounting_r09.json``)
+carries the registry-backed ``StepProfile`` dicts plus a ``claims``
+block where every statement is a recomputable boolean over the same
+numbers.  Run on the target chip for VERDICT-grade figures; a CPU run
+is structurally identical (the artifact records the backend).
+
+  JAX_PLATFORMS=cpu PYTHONPATH=. python benchmarks/decode_accounting.py \
+      --model tiny --batches 32 64
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bluefog_tpu import models, observe
+from bluefog_tpu.benchutil import device_fetch, fetch_overhead
+from bluefog_tpu.models.generate import (decode_config, decode_token_step,
+                                         init_cache)
+from bluefog_tpu.models.llama import Llama
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--model", default="tiny", choices=["tiny", "200m"])
+parser.add_argument("--batches", type=int, nargs="+", default=[32, 64])
+parser.add_argument("--prompt-len", type=int, default=128,
+                    help="cache fill level the step decodes at (shapes "
+                    "cover prompt_len + 64 positions)")
+parser.add_argument("--kv-quant", default="none", choices=["none", "int8"])
+parser.add_argument("--weight-quant", default="none",
+                    choices=["none", "int8", "w8a8"])
+parser.add_argument("--steps", type=int, default=16,
+                    help="decode steps per timed run (chained by token "
+                    "feedback, the serving dispatch pattern)")
+parser.add_argument("--repeats", type=int, default=3)
+parser.add_argument("--out",
+                    default=os.path.join(HERE,
+                                         "decode_accounting_r09.json"))
+
+
+def make_config(name):
+    if name == "tiny":
+        return models.LlamaConfig.tiny(dtype=jnp.float32)
+    return models.LlamaConfig(
+        vocab_size=32000, dim=1024, n_layers=12, n_heads=16,
+        n_kv_heads=4, hidden_dim=2816, max_seq_len=8192,
+        dtype=jnp.bfloat16)
+
+
+def profile_batch(cfg, variables, B, args):
+    """One batch size: compile the greedy decode step (token in, token
+    out — sampling included, it is part of the serving step), profile
+    it, and time ``--steps`` chained executions."""
+    max_len = args.prompt_len + 64
+    dcfg = decode_config(cfg, max_len, kv_quant=args.kv_quant,
+                         weight_quant=args.weight_quant)
+    cache = init_cache(cfg, B, max_len, kv_quant=args.kv_quant)
+    params = variables["params"]
+
+    @jax.jit
+    def step(params, cache, tok):
+        model = Llama(dcfg)
+        logits, cache = decode_token_step(model, params, cache, tok)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None], cache
+
+    tok = jnp.zeros((B, 1), jnp.int32)
+    prof = observe.profile_step(step, params, cache, tok,
+                                name=f"decode.B{B}")
+
+    # timed: chain steps through the token (and cache) feedback so the
+    # loop dispatches the way a serving decode loop does
+    def run(n):
+        t, c = tok, cache
+        for _ in range(n):
+            t, c = step(params, c, t)
+        return t
+
+    device_fetch(run(2))  # compile + warm
+    ov = fetch_overhead()
+    times = []
+    for _ in range(args.repeats):
+        import time as _time
+
+        t0 = _time.perf_counter()
+        device_fetch(run(args.steps))
+        times.append(max(_time.perf_counter() - t0 - ov, 1e-9))
+    step_s = float(np.median(times)) / args.steps
+    prof.step_seconds = step_s
+    if observe.enabled():
+        prof.publish()
+
+    d = prof.to_dict()
+    # the window list is overlap machinery; decode has no collectives
+    d.pop("windows")
+    d.update(
+        batch=B,
+        tokens_per_sec=B / step_s,
+        flops_per_token=prof.flops / B,
+        cost_bytes_per_token=prof.cost_bytes_accessed / B,
+    )
+    return d
+
+
+def main():
+    args = parser.parse_args()
+    cfg = make_config(args.model)
+    variables = Llama(cfg).init(jax.random.PRNGKey(0),
+                                jnp.zeros((2, 4), jnp.int32))
+    if args.weight_quant != "none":
+        from bluefog_tpu.models import quantize_llama_params
+
+        variables = jax.jit(quantize_llama_params)(variables)
+        device_fetch(variables)
+
+    rows = [profile_batch(cfg, variables, B, args) for B in args.batches]
+    rows.sort(key=lambda r: r["batch"])  # claims compare small -> large
+    lo, hi = rows[0], rows[-1]
+    b_ratio = hi["batch"] / lo["batch"]
+    flops_ratio = hi["flops"] / lo["flops"]
+    time_ratio = hi["step_seconds"] / lo["step_seconds"]
+    # step time predicted by compute scaling alone; what measured time
+    # carries beyond it is dispatch/layout/latency overhead
+    overhead_share = max(0.0, 1.0 - (lo["step_seconds"] * flops_ratio)
+                         / hi["step_seconds"])
+    claims = {
+        # decode arithmetic scales with the batch: per-token FLOPs flat
+        "per_token_flops_flat": {
+            "value": hi["flops_per_token"] / lo["flops_per_token"],
+            "checked": abs(hi["flops_per_token"] / lo["flops_per_token"]
+                           - 1.0) < 0.15,
+        },
+        # the weight stream is shared: per-token bytes FALL with batch
+        # (cost-analysis bytes; 0.0 when the backend reports none)
+        "per_token_bytes_fall_with_batch": {
+            "value": (hi["cost_bytes_per_token"]
+                      / lo["cost_bytes_per_token"]
+                      if lo["cost_bytes_per_token"] else None),
+            "checked": (hi["cost_bytes_per_token"]
+                        < lo["cost_bytes_per_token"]
+                        if lo["cost_bytes_per_token"] else None),
+        },
+        # the round-5 observation under test: does aggregate throughput
+        # regress from the smaller to the larger batch on this backend?
+        "throughput_regresses": {
+            "value": hi["tokens_per_sec"] / lo["tokens_per_sec"],
+            "checked": hi["tokens_per_sec"] < lo["tokens_per_sec"],
+        },
+        # attribution: measured step time beyond compute scaling.  When
+        # throughput regresses with flat per-token flops and falling
+        # per-token bytes, THIS is the regression — overhead, not
+        # arithmetic, not streaming.
+        "step_time_ratio_vs_flops_ratio": {
+            "batch_ratio": b_ratio,
+            "flops_ratio": flops_ratio,
+            "time_ratio": time_ratio,
+            "overhead_share_at_large_batch": overhead_share,
+            "checked": time_ratio > 0,
+        },
+    }
+    art = {
+        "bench": "decode_accounting",
+        "round": 9,
+        "model": args.model,
+        "backend": jax.default_backend(),
+        "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+        "config": {
+            "prompt_len": args.prompt_len, "kv_quant": args.kv_quant,
+            "weight_quant": args.weight_quant, "steps": args.steps,
+            "repeats": args.repeats,
+        },
+        "note": "Closes the round-5 VERDICT gap 'no per-op accounting "
+                "at B=32/64': every figure is a StepProfile from "
+                "observe.profile_step (XLA cost analysis + HLO op "
+                "breakdown), and every claim is a recomputable boolean "
+                "over those figures.  Run on v5e for the VERDICT-grade "
+                "numbers; this artifact records whichever backend "
+                "produced it.",
+        "profiles": rows,
+        "claims": claims,
+    }
+    with open(args.out, "w") as f:
+        json.dump(art, f, indent=1)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    for r in rows:
+        print(f"  B={r['batch']}: {r['tokens_per_sec']:.1f} tok/s, "
+              f"{r['flops_per_token']:.3g} flops/tok, "
+              f"mfu={r['mfu']:.4f}")
+    print(f"  overhead_share at B={hi['batch']}: {overhead_share:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -121,7 +121,12 @@ def run_continuous(variables, cfg, args, arrivals, prompts, budgets):
     makespan = max(finish_t.values())
     useful = sum(len(r.tokens) for r in reqs)
     m = eng.metrics.summary()
+    # HLO-attributed profiles of the two resident programs (observe
+    # subsystem) — the per-op cost side of the throughput numbers,
+    # registry-backed instead of a hand-rolled dict
+    profiles = {k: p.to_dict() for k, p in eng.profile().items()}
     return {
+        "step_profiles": profiles,
         "tokens_per_sec": useful / makespan,
         "useful_tokens": int(useful),
         "makespan_s": makespan,

@@ -116,6 +116,7 @@ from bluefog_tpu.topology import (  # noqa: F401
     # picked by machine-counted congestion + mixing score (torus.py)
     default_pod_schedule,
 )
+from bluefog_tpu import observe  # noqa: F401
 from bluefog_tpu import optim  # noqa: F401
 from bluefog_tpu import resilience  # noqa: F401
 from bluefog_tpu import data  # noqa: F401

@@ -13,6 +13,8 @@ import os
 __all__ = [
     "log_level",
     "log_hide_time",
+    "log_format",
+    "observe",
     "timeline_path",
     "skip_negotiate_default",
     "ops_on_cpu",
@@ -35,6 +37,22 @@ def log_level() -> str:
 def log_hide_time() -> bool:
     """BLUEFOG_LOG_HIDE_TIME (reference logging.h:76)."""
     return _env("BLUEFOG_LOG_HIDE_TIME", "0") in ("1", "true", "True")
+
+
+def log_format() -> str:
+    """BLUEFOG_LOG_FORMAT: ``text`` (default, human-readable) or
+    ``json`` — one JSON object per line with rank/timestamp/level, the
+    shape log aggregators ingest without a parse rule."""
+    return _env("BLUEFOG_LOG_FORMAT", "text").lower()
+
+
+def observe() -> bool:
+    """BLUEFOG_OBSERVE (default on): whether the built-in publishers
+    write into the observability registry/tracer
+    (:mod:`bluefog_tpu.observe`).  ``0`` opts out."""
+    from bluefog_tpu.observe.registry import enabled
+
+    return enabled()
 
 
 def timeline_path() -> str:

@@ -391,7 +391,7 @@ class BluefogContext:
         self._handle_lock = threading.Lock()
         self._handle_map: Dict[int, Tuple[str, Any]] = {}
         self._inflight_names: set = set()
-        self._timeline_open: set = set()
+        self._timeline_open: Dict = {}  # span key -> tracer it began on
         self._next_handle = 0
 
         self.windows: Dict[str, Any] = {}  # name -> Window (windows.py)
@@ -594,22 +594,38 @@ class BluefogContext:
             self._op_cache[key] = fn
         return fn
 
+    def _op_tracer(self):
+        """Where op spans go (``observe.tracer.effective_tracer``: the
+        global tracer, or under ``BLUEFOG_OBSERVE=0`` the started
+        timeline's private tracer, or None)."""
+        from bluefog_tpu.observe.tracer import effective_tracer
+
+        return effective_tracer(self.timeline)
+
     def run_op(self, key: Tuple, kernel: Callable, x, *aux) -> jax.Array:
-        """Dispatch one eager collective.  With the timeline enabled this
-        records the reference's ENQUEUE_<OP> span around the host-side
-        dispatch (reference torch/mpi_ops.cc:178-488 starts the span at the
-        binding, operations.cc:760 ends it when the background thread picks
-        the entry up; here "enqueue" is trace-lookup + XLA dispatch)."""
+        """Dispatch one eager collective.  Records the reference's
+        ENQUEUE_<OP> span around the host-side dispatch (reference
+        torch/mpi_ops.cc:178-488 starts the span at the binding,
+        operations.cc:760 ends it when the background thread picks the
+        entry up; here "enqueue" is trace-lookup + XLA dispatch) into
+        the observe tracer, and counts the dispatch in
+        ``bf_ops_total{op=}``."""
+        from bluefog_tpu.observe import registry as obs_registry
+
         x = self.rank_sharded(x)
         op = str(key[0])
-        tl = self.timeline
-        if tl is None:
+        if obs_registry.enabled():
+            obs_registry.get_registry().counter(
+                "bf_ops_total", "eager collective dispatches",
+                op=op).inc()
+        tr = self._op_tracer()
+        if tr is None:
             return self._shardmapped(key, kernel, len(aux))(x, *aux)
-        tl.start_activity(op, f"ENQUEUE_{op.upper()}")
+        tr.begin(op, f"ENQUEUE_{op.upper()}")
         try:
             return self._shardmapped(key, kernel, len(aux))(x, *aux)
         finally:
-            tl.end_activity(op)
+            tr.end(op)
 
     # ------------------------------------------------------------------ #
     # handles (reference torch/handle_manager.{h,cc} + mpi_ops.py:947-1005)
@@ -631,11 +647,14 @@ class BluefogContext:
         # vendor op name appears as MPI_<OP>; here the data plane is XLA,
         # so the nested span is XLA_<OP>).  The span runs from dispatch
         # until device completion is observed at synchronize/wait.
-        tl = self.timeline
-        if tl is not None:
-            tl.start_activity(key, "COMMUNICATE")
-            tl.start_activity(key, f"XLA_{op.upper()}")
-            self._timeline_open.add(key)
+        tr = self._op_tracer()
+        if tr is not None:
+            tr.begin(key, "COMMUNICATE")
+            tr.begin(key, f"XLA_{op.upper()}")
+            # remember WHICH tracer the spans began on: a BLUEFOG_OBSERVE
+            # flip between dispatch and synchronize must not send the E
+            # records to a different tracer than the B records
+            self._timeline_open[key] = tr
         return handle
 
     def synchronize(self, handle: int):
@@ -650,11 +669,10 @@ class BluefogContext:
             # close spans even when the collective fails (a dead peer
             # raises here) — the trace must stay B/E-balanced precisely
             # in the failure case where it gets inspected
-            tl = self.timeline
-            if tl is not None and key in self._timeline_open:
-                tl.end_activity(key)  # XLA_<OP>
-                tl.end_activity(key)  # COMMUNICATE
-                self._timeline_open.discard(key)
+            tr = self._timeline_open.pop(key, None)
+            if tr is not None:
+                tr.end(key)  # XLA_<OP>
+                tr.end(key)  # COMMUNICATE
 
     def poll(self, handle: int) -> bool:
         with self._handle_lock:

@@ -3,11 +3,19 @@
 Reference parity: the C++ ``BFLOG`` macros (bluefog/common/logging.h:54-73)
 and the Python logger "bluefog" (bluefog/common/basics.py:27-34).  Level
 comes from ``BLUEFOG_LOG_LEVEL`` with the same names.
+
+``BLUEFOG_LOG_FORMAT=json`` switches to structured output: one JSON
+object per line carrying ``ts`` (unix seconds), ``level``, ``logger``,
+``rank``, and ``msg`` — what a log aggregator ingests without a parse
+rule, and the textual counterpart of the observe subsystem's JSONL
+event log (docs/observability.md).
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import sys
 
 from bluefog_tpu import config as bfconfig
@@ -25,16 +33,38 @@ _LEVELS = {
 _logger = None
 
 
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per record; exceptions fold into ``exc``."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        obj = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "rank": int(os.environ.get("BLUEFOG_TPU_PROCESS_ID", "0")),
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            obj["exc"] = self.formatException(record.exc_info)
+        return json.dumps(obj)
+
+
+def _make_formatter() -> logging.Formatter:
+    if bfconfig.log_format() == "json":
+        return _JsonFormatter()
+    fmt = "[%(levelname)s] %(name)s: %(message)s"
+    if not bfconfig.log_hide_time():
+        fmt = "%(asctime)s " + fmt
+    return logging.Formatter(fmt)
+
+
 def get_logger() -> logging.Logger:
     global _logger
     if _logger is None:
         logger = logging.getLogger("bluefog_tpu")
         logger.setLevel(_LEVELS.get(bfconfig.log_level(), logging.WARNING))
         handler = logging.StreamHandler(sys.stderr)
-        fmt = "[%(levelname)s] %(name)s: %(message)s"
-        if not bfconfig.log_hide_time():
-            fmt = "%(asctime)s " + fmt
-        handler.setFormatter(logging.Formatter(fmt))
+        handler.setFormatter(_make_formatter())
         logger.addHandler(handler)
         logger.propagate = False
         _logger = logger

@@ -1,0 +1,41 @@
+"""Unified observability: metrics registry, span tracer, step profiler.
+
+The one telemetry layer every subsystem reports into (the reference
+treats its C++ ``Timeline`` as first-class infrastructure; this
+subsystem extends that stance to metrics and per-op cost attribution):
+
+* :mod:`~bluefog_tpu.observe.registry` — process-local counters,
+  gauges, and windowed histograms with labeled families; cheap enough
+  for per-step use, host-side only (enabling it never touches a
+  compiled program — asserted via jit cache sizes and bit-identical
+  step outputs in tests/test_observe.py);
+* :mod:`~bluefog_tpu.observe.tracer` — nested spans / instant events /
+  per-thread tracks; the serving engine, resilience runner, eager op
+  API, and ``build_train_step`` wrappers publish here, and
+  ``timeline.py`` is a thin Chrome-trace exporter over it;
+* :mod:`~bluefog_tpu.observe.stepprof` — ``profile_step`` returns a
+  :class:`StepProfile` (FLOPs, per-collective bytes, overlap windows,
+  MFU) from XLA's own view of the compiled module;
+* :mod:`~bluefog_tpu.observe.export` — Prometheus text / JSONL event
+  log / Chrome trace, plus the one-call ``bf.observe.snapshot()``.
+
+Opt out with ``BLUEFOG_OBSERVE=0`` (publication stops; explicitly-held
+registries/tracers keep working).  See docs/observability.md.
+"""
+
+from bluefog_tpu.observe.registry import (Counter, Gauge, Histogram,
+                                          MetricsRegistry, enabled,
+                                          get_registry, percentile)
+from bluefog_tpu.observe.tracer import Tracer, get_tracer, publish_tracer
+from bluefog_tpu.observe.stepprof import (StepProfile, hlo_op_breakdown,
+                                          profile_step)
+from bluefog_tpu.observe.export import (chrome_trace, jsonl_events,
+                                        prometheus_text, snapshot)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "enabled",
+    "get_registry", "percentile",
+    "Tracer", "get_tracer", "publish_tracer",
+    "StepProfile", "profile_step", "hlo_op_breakdown",
+    "prometheus_text", "jsonl_events", "chrome_trace", "snapshot",
+]

@@ -422,6 +422,31 @@ def _combine_fn(spec: CommSpec, axis_name: str,
                                        compress=compress), tree)
 
 
+def _observed_step(step_fn: Callable, labels: dict) -> Callable:
+    """Host-side observability wrapper for a built train step: each
+    dispatch increments ``bf_train_steps_total{comm_mode,overlap,
+    guarded}`` and runs inside a ``train_step`` span on the ``train``
+    track.  Everything happens OUTSIDE the traced program — the wrapper
+    calls the same jitted executable, so jit cache sizes and step
+    outputs are bit-identical with ``BLUEFOG_OBSERVE`` on or off
+    (asserted in tests/test_observe.py).  The span measures host
+    dispatch (jax is async); sync before reading it as a step time."""
+
+    def step(*args, **kwargs):
+        from bluefog_tpu import observe
+
+        tr = observe.publish_tracer()
+        if tr is None:
+            return step_fn(*args, **kwargs)
+        observe.get_registry().counter(
+            "bf_train_steps_total", "train-step dispatches",
+            **labels).inc()
+        with tr.span("train", "train_step"):
+            return step_fn(*args, **kwargs)
+
+    return step
+
+
 def build_train_step(
     loss_fn: Callable[[Any, Any], jax.Array],
     optimizer: optax.GradientTransformation,
@@ -746,6 +771,9 @@ def build_train_step(
     squeeze = lambda t: jax.tree.map(lambda x: x[0], t)
     expand = lambda t: jax.tree.map(lambda x: x[None], t)
 
+    obs_labels = dict(comm_mode=comm_mode, overlap=overlap,
+                      guarded="false")
+
     def wrapped(params, aux, opt_state, batch, step):
         # strip the leading per-shard rank axis of size 1
         params, aux, opt_state, loss = per_rank_step(
@@ -772,19 +800,23 @@ def build_train_step(
     donate_argnums = (0, 1, 2) if donate else ()
     jitted = jax.jit(sm, donate_argnums=donate_argnums)
     if has_aux:
-        return jitted
+        aux_step = _observed_step(jitted, obs_labels)
+        aux_step.jitted = jitted
+        aux_step.lower = jitted.lower
+        return aux_step
 
     def no_aux_step(params, opt_state, batch, step):
         params, _, opt_state, loss = jitted(
             params, (), opt_state, batch, step)
         return params, opt_state, loss
 
+    step_fn = _observed_step(no_aux_step, obs_labels)
     # AOT access for benchmarks: lower/compile the real program (e.g. for
     # XLA cost analysis / MFU accounting) without re-jitting the wrapper.
-    no_aux_step.jitted = jitted
-    no_aux_step.lower = lambda params, opt_state, batch, step: jitted.lower(
+    step_fn.jitted = jitted
+    step_fn.lower = lambda params, opt_state, batch, step: jitted.lower(
         params, (), opt_state, batch, step)
-    return no_aux_step
+    return step_fn
 
 
 def _build_guarded_train_step(
@@ -926,27 +958,34 @@ def _build_guarded_train_step(
     jitted = jax.jit(sm, donate_argnums=donate_argnums)
     default_w = comm_weight_inputs(specs) if wbranches else ()
 
+    obs_labels = dict(
+        comm_mode=comm_mode,
+        overlap="bucketed" if n_buckets is not None else "none",
+        guarded="true")
+
     if has_aux:
         def aux_step(params, aux, opt_state, batch, step, comm_weights):
             return jitted(params, aux, opt_state, batch, step,
                           comm_weights)
 
-        aux_step.jitted = jitted
-        aux_step.default_comm_weights = default_w
-        aux_step.has_aux = True  # run_resilient rejects aux signatures
-        aux_step.guard_config = guard
-        return aux_step
+        step_fn = _observed_step(aux_step, obs_labels)
+        step_fn.jitted = jitted
+        step_fn.default_comm_weights = default_w
+        step_fn.has_aux = True  # run_resilient rejects aux signatures
+        step_fn.guard_config = guard
+        return step_fn
 
     def no_aux_step(params, opt_state, batch, step, comm_weights):
         params, _, opt_state, loss, skipped = jitted(
             params, (), opt_state, batch, step, comm_weights)
         return params, opt_state, loss, skipped
 
-    no_aux_step.jitted = jitted
-    no_aux_step.lower = (
+    step_fn = _observed_step(no_aux_step, obs_labels)
+    step_fn.jitted = jitted
+    step_fn.lower = (
         lambda params, opt_state, batch, step, comm_weights:
         jitted.lower(params, (), opt_state, batch, step, comm_weights))
-    no_aux_step.default_comm_weights = default_w
-    no_aux_step.has_aux = False
-    no_aux_step.guard_config = guard
-    return no_aux_step
+    step_fn.default_comm_weights = default_w
+    step_fn.has_aux = False
+    step_fn.guard_config = guard
+    return step_fn

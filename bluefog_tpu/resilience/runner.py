@@ -33,6 +33,7 @@ from typing import Any, Callable, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from bluefog_tpu import observe
 from bluefog_tpu.context import BluefogError
 from bluefog_tpu.optim.functional import GuardConfig
 from bluefog_tpu.resilience.detector import FailureDetector
@@ -127,6 +128,14 @@ def run_resilient(
     def emit(kind: str, step: int, **detail):
         ev = ResilienceEvent(kind, step, detail)
         events.append(ev)
+        # aggregate the run's events where a dashboard can see them —
+        # the event list was previously consumed (or not) by each caller
+        if observe.enabled():
+            observe.get_registry().counter(
+                "bf_resilience_events_total",
+                "resilience control-loop events", kind=kind).inc()
+            observe.get_tracer().instant(f"resilience.{kind}",
+                                         track="resilience")
         if on_event is not None:
             on_event(ev)
 
@@ -157,6 +166,12 @@ def run_resilient(
         sk = np.asarray(skipped).reshape(-1) != 0
         detector.observe(sk)
         total_skips += sk
+        if sk.any() and observe.enabled():
+            reg = observe.get_registry()
+            for r in np.nonzero(sk)[0]:
+                reg.counter("bf_resilience_skips_total",
+                            "guarded-step skips (replays included)",
+                            rank=int(r)).inc()
         last_loss = np.asarray(loss)
         live_bad = detector.live_bad(sk)
         if live_bad:

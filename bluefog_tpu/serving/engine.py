@@ -396,6 +396,31 @@ class ServingEngine:
                 return
         raise RuntimeError(f"engine still busy after {max_steps} steps")
 
+    def profile(self, **kw) -> Dict[str, "object"]:
+        """HLO-attributed :class:`~bluefog_tpu.observe.StepProfile` of
+        the two resident device programs (``prefill_chunk`` and
+        ``decode_step``), via :func:`bluefog_tpu.observe.profile_step`.
+        AOT — compiles (hitting the jit cache when the engine already
+        ran) but executes nothing, so it is safe on a live engine.
+        Keyword args (``step_seconds``, chip figures, ...) pass
+        through; the serving bench emits these instead of hand-rolled
+        cost dicts."""
+        from bluefog_tpu.observe import profile_step
+
+        cap = self.pool.capacity
+        prefill = profile_step(
+            _prefill_chunk_prog, self._params, self.pool.cache,
+            jnp.int32(0), jnp.zeros((1, self.prefill_chunk), jnp.int32),
+            jnp.int32(0), cfg=self.cfg,
+            name="serving.prefill_chunk", **kw)
+        decode = profile_step(
+            _decode_step_prog, self._params, self.pool.cache,
+            jnp.zeros((cap,), jnp.int32), jnp.zeros((cap,), bool),
+            jnp.zeros((cap, 2), jnp.uint32), jnp.zeros((cap,), jnp.int32),
+            jnp.zeros((cap,), jnp.float32), cfg=self.cfg,
+            horizon=self.decode_horizon, name="serving.decode_step", **kw)
+        return {"prefill_chunk": prefill, "decode_step": decode}
+
     # -- internals ----------------------------------------------------- #
     def _prefill_one_chunk(self, req: Request) -> None:
         # chunks cover prompt[:-1] — the K/V everyone after needs; the

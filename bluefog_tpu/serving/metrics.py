@@ -1,4 +1,4 @@
-"""Serving metrics + request-lifecycle timeline spans.
+"""Serving metrics + request-lifecycle spans, on the observe substrate.
 
 Numbers a serving operator actually pages on:
 
@@ -11,11 +11,23 @@ Numbers a serving operator actually pages on:
   occupancy under load means admission is the bottleneck, deep queues
   mean capacity is.
 
-Lifecycle spans go through the existing :mod:`bluefog_tpu.timeline`
-writer (same chrome://tracing file format as the op-level spans), one
-track per request: ``admission -> prefill -> decode -> retire``.  Load a
-timeline in chrome://tracing and the continuous-batching interleaving is
-visible directly — staggered prefills riding between decode steps.
+Everything is published twice, through the unified observability layer
+(:mod:`bluefog_tpu.observe`):
+
+* the :class:`~bluefog_tpu.observe.registry.MetricsRegistry` —
+  counters (``bf_serving_requests_total``,
+  ``bf_serving_retired_total{outcome=}``), windowed histograms
+  (``bf_serving_ttft_seconds``, ``bf_serving_latency_seconds``), and
+  per-step gauges, scrapeable as Prometheus text;
+* the :class:`~bluefog_tpu.observe.tracer.Tracer` — one track per
+  request (``admission -> prefill -> decode -> retire``), which the
+  Chrome-trace timeline exports when started: load a timeline in
+  chrome://tracing and the continuous-batching interleaving is visible
+  directly — staggered prefills riding between decode steps.
+
+``summary()`` keeps its original dict shape (the operator dashboard the
+serving tests and bench consume); ``BLUEFOG_OBSERVE=0`` stops the
+registry/tracer publication while leaving the summary intact.
 
 All timestamps come from the engine's injected clock, so tests drive
 virtual time and percentiles are deterministic.
@@ -28,61 +40,85 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from bluefog_tpu import timeline as timeline_mod
+from bluefog_tpu.observe import registry as obs_registry
+from bluefog_tpu.observe import tracer as obs_tracer
+from bluefog_tpu.observe.registry import percentile  # noqa: F401  (moved
+# to observe/registry.py; re-exported here for backward compatibility)
 
 __all__ = ["ServingMetrics", "percentile"]
 
 
-def percentile(values, q: float) -> float:
-    """Linear-interpolation percentile (numpy's default); 0.0 on empty —
-    summaries stay total-function even for a load that never finished a
-    request."""
-    vals = [v for v in values if v is not None]
-    if not vals:
-        return 0.0
-    return float(np.percentile(np.asarray(vals, np.float64), q))
-
-
 class _RequestRecord:
     __slots__ = ("submit_t", "admit_t", "first_token_t", "finish_t",
-                 "n_tokens", "outcome")
+                 "n_tokens", "outcome", "tracer")
 
-    def __init__(self, submit_t: float):
+    def __init__(self, submit_t: float, tracer=None):
         self.submit_t = submit_t
         self.admit_t: Optional[float] = None
         self.first_token_t: Optional[float] = None
         self.finish_t: Optional[float] = None
         self.n_tokens = 0
         self.outcome: Optional[str] = None
+        # the tracer the request's spans BEGAN on, pinned at submit: a
+        # BLUEFOG_OBSERVE flip or timeline stop mid-request must not
+        # send the closing E records to a different tracer than the Bs
+        # (same policy as context._timeline_open)
+        self.tracer = tracer
 
 
 class ServingMetrics:
-    def __init__(self):
+    """Per-engine request records + publication into the global
+    registry/tracer (opt out with ``BLUEFOG_OBSERVE=0``; pass an
+    explicit ``registry=`` to isolate, e.g. per-test)."""
+
+    def __init__(self, registry=None):
         self._req: Dict[object, _RequestRecord] = {}
         self._occupancy: List[float] = []
         self._queue_depth: List[int] = []
         self.n_rejected = 0
+        self._registry = registry
 
-    # -- timeline plumbing -------------------------------------------- #
+    # -- observe plumbing --------------------------------------------- #
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        if not obs_registry.enabled():
+            return None
+        return obs_registry.get_registry()
+
+    def _tracer(self):
+        return obs_tracer.effective_tracer(timeline_mod.get_timeline())
+
     def _span(self, rid, activity: Optional[str]):
         """Close the request's open span and (unless retiring) open the
-        next lifecycle phase on its per-request track."""
-        tl = timeline_mod.get_timeline()
-        if tl is None:
+        next lifecycle phase on its per-request track — on the tracer
+        the request's spans began on."""
+        rec = self._req.get(rid)
+        tr = rec.tracer if rec is not None else None
+        if tr is None:
             return
         track = f"request.{rid}"
-        tl.end_activity(track)
+        tr.end(track)
         if activity is not None:
-            tl.start_activity(track, activity)
+            tr.begin(track, activity)
 
     # -- lifecycle events (engine calls these) ------------------------ #
     def on_submit(self, rid, now: float):
-        self._req[rid] = _RequestRecord(now)
-        tl = timeline_mod.get_timeline()
-        if tl is not None:
-            tl.start_activity(f"request.{rid}", "admission")
+        tr = self._tracer()
+        self._req[rid] = _RequestRecord(now, tracer=tr)
+        if tr is not None:
+            tr.begin(f"request.{rid}", "admission")
+        reg = self._reg()
+        if reg is not None:
+            reg.counter("bf_serving_requests_total",
+                        "requests submitted").inc()
 
     def on_reject(self, rid, now: float):
         self.n_rejected += 1
+        reg = self._reg()
+        if reg is not None:
+            reg.counter("bf_serving_rejected_total",
+                        "requests refused (backpressure or too long)").inc()
 
     def on_admit(self, rid, now: float):
         self._req[rid].admit_t = now
@@ -93,9 +129,20 @@ class ServingMetrics:
         rec.first_token_t = now
         rec.n_tokens += 1
         self._span(rid, "decode")
+        reg = self._reg()
+        if reg is not None:
+            reg.histogram("bf_serving_ttft_seconds",
+                          "submit -> first token").observe(
+                              now - rec.submit_t)
+            reg.counter("bf_serving_tokens_total",
+                        "tokens generated").inc()
 
     def on_token(self, rid, now: float):
         self._req[rid].n_tokens += 1
+        reg = self._reg()
+        if reg is not None:
+            reg.counter("bf_serving_tokens_total",
+                        "tokens generated").inc()
 
     def on_retire(self, rid, now: float, outcome: str):
         rec = self._req[rid]
@@ -103,13 +150,26 @@ class ServingMetrics:
         rec.outcome = outcome
         self._span(rid, "retire")
         self._span(rid, None)
-        tl = timeline_mod.get_timeline()
-        if tl is not None:
-            tl.instant(f"request.{rid}.{outcome}")
+        tr = rec.tracer
+        if tr is not None:
+            tr.instant(f"request.{rid}.{outcome}")
+        reg = self._reg()
+        if reg is not None:
+            reg.counter("bf_serving_retired_total",
+                        "requests retired", outcome=outcome).inc()
+            reg.histogram("bf_serving_latency_seconds",
+                          "submit -> retire").observe(now - rec.submit_t)
 
     def on_step(self, occupancy: float, queue_depth: int):
         self._occupancy.append(occupancy)
         self._queue_depth.append(queue_depth)
+        reg = self._reg()
+        if reg is not None:
+            reg.counter("bf_serving_steps_total", "engine steps").inc()
+            reg.gauge("bf_serving_slot_occupancy",
+                      "active slots / capacity, last step").set(occupancy)
+            reg.gauge("bf_serving_queue_depth",
+                      "queued requests, last step").set(queue_depth)
 
     # -- summaries ----------------------------------------------------- #
     def ttfts(self) -> List[float]:

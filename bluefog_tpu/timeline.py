@@ -1,4 +1,4 @@
-"""Chrome-tracing timeline.
+"""Chrome-tracing timeline — now a thin exporter over the span tracer.
 
 Reference parity: the C++ ``Timeline``/``TimelineWriter`` pair
 (bluefog/common/timeline.{h,cc}) which streams per-op activity spans to
@@ -8,12 +8,26 @@ this module records the *framework-level* activity spans (enqueue, compute,
 update phases) with the same file format so the reference's timeline
 tooling (chrome://tracing) works unchanged.
 
+The span machinery itself lives in
+:class:`bluefog_tpu.observe.tracer.Tracer`; a :class:`Timeline` is a
+tracer plus a file-writer **sink** (the writers' ``record(name, tid,
+phase)`` surface is exactly the tracer's sink protocol).
+``start_timeline`` attaches the writer to the process-global tracer, so
+every subsystem that publishes spans — the serving engine, the
+resilience runner, the eager op API — lands in the Chrome-trace file
+automatically.
+
 Two writer backends:
 
 * **native** (default when buildable) — the C++ lock-free SPSC ring +
   writer thread in ``bluefog_tpu/native/bf_native.cc``, the direct
   equivalent of the reference's boost::lockfree design (timeline.h:65-67).
-* **python** — a queue.Queue + thread fallback, always available.
+* **python** — a bounded queue.Queue + thread fallback, always available.
+  Like the native ring, the queue REFUSES events when the writer thread
+  falls behind (an unbounded queue would trade a bounded trace gap for
+  unbounded host memory) and counts the drops; ``close()`` flushes the
+  count to the ``bf_timeline_dropped_events`` registry gauge so a
+  saturated writer is visible on the metrics side, not silently lossy.
 
 Set ``BLUEFOG_TIMELINE_NATIVE=0`` to force the Python backend.
 """
@@ -29,17 +43,31 @@ import time
 from contextlib import contextmanager
 from typing import Optional
 
+from bluefog_tpu.observe import registry as _obs_registry
+from bluefog_tpu.observe import tracer as _obs_tracer
+
 __all__ = ["Timeline", "get_timeline", "start_timeline", "stop_timeline"]
+
+# Python-backend queue bound: ~the native ring's depth.  Override with
+# BLUEFOG_TIMELINE_QUEUE_CAPACITY for stress tests.
+_DEFAULT_QUEUE_CAPACITY = 65536
 
 
 class _PyWriter:
-    """Fallback writer: queue.Queue + daemon thread (GIL stands in for the
-    native ring's memory ordering)."""
+    """Fallback writer: bounded queue.Queue + daemon thread (GIL stands
+    in for the native ring's memory ordering; the bound stands in for
+    the ring's fixed depth — a full queue drops the event and counts
+    it, same contract as the native writer)."""
 
-    def __init__(self, path: str, rank: int):
+    def __init__(self, path: str, rank: int, capacity: Optional[int] = None):
         self.rank = rank
         self._t0 = time.perf_counter()
-        self._queue: "queue.Queue" = queue.Queue()
+        if capacity is None:
+            capacity = int(os.environ.get(
+                "BLUEFOG_TIMELINE_QUEUE_CAPACITY",
+                str(_DEFAULT_QUEUE_CAPACITY)))
+        self._queue: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self._dropped = 0
         self._file = open(path, "w")
         self._file.write("[\n")
         self._first = True
@@ -62,20 +90,26 @@ class _PyWriter:
             self._file.write(json.dumps(event))
             self._file.flush()
 
+    def _put(self, event: dict) -> None:
+        try:
+            self._queue.put_nowait(event)
+        except queue.Full:
+            self._dropped += 1
+
     def record(self, name: str, tid: str, phase: str):
         ts = self._now_us()
         if phase == "B":
-            self._queue.put({"name": name, "cat": tid, "ph": "B", "ts": ts,
-                             "pid": self.rank, "tid": tid})
+            self._put({"name": name, "cat": tid, "ph": "B", "ts": ts,
+                       "pid": self.rank, "tid": tid})
         elif phase == "E":
-            self._queue.put({"ph": "E", "ts": ts, "pid": self.rank,
-                             "tid": tid})
+            self._put({"ph": "E", "ts": ts, "pid": self.rank,
+                       "tid": tid})
         else:
-            self._queue.put({"name": name, "ph": "i", "ts": ts,
-                             "pid": self.rank, "s": "p"})
+            self._put({"name": name, "ph": "i", "ts": ts,
+                       "pid": self.rank, "s": "p"})
 
     def dropped(self) -> int:
-        return 0
+        return self._dropped
 
     def close(self):
         if self._stop.is_set():
@@ -108,39 +142,41 @@ def _make_writer(path: str, rank: int, use_native: Optional[bool]):
 
 
 class Timeline:
+    """A Chrome-trace file fed by a :class:`Tracer`.
+
+    With ``tracer=None`` the timeline owns a private tracer (standalone
+    use, e.g. tests); ``start_timeline`` passes the process-global
+    tracer instead, making the file a live export of everything the
+    framework publishes.  The legacy span surface
+    (``start_activity``/``end_activity``/``instant``) forwards to the
+    tracer, so existing callers see no change."""
+
     def __init__(self, path: str, rank: int = 0,
-                 use_native: Optional[bool] = None):
+                 use_native: Optional[bool] = None, tracer=None):
         self.path = f"{path}{rank}.json"
         self.rank = rank
         self._writer, self.backend = _make_writer(self.path, rank, use_native)
-        self._lock = threading.Lock()  # writers are single-producer
-        self._open_spans = {}
+        self.tracer = tracer if tracer is not None else _obs_tracer.Tracer(
+            pid=rank)
+        self.tracer.add_sink(self._writer)
         self._closed = False
         atexit.register(self.close)
 
     def start_activity(self, tensor_name: str, activity: str):
-        with self._lock:
-            self._open_spans.setdefault(tensor_name, []).append(activity)
-            self._writer.record(activity, tensor_name, "B")
+        self.tracer.begin(tensor_name, activity)
 
     def end_activity(self, tensor_name: str):
-        with self._lock:
-            spans = self._open_spans.get(tensor_name)
-            if spans:
-                spans.pop()
-            self._writer.record("", tensor_name, "E")
+        self.tracer.end(tensor_name)
 
     def instant(self, name: str):
-        with self._lock:
-            self._writer.record(name, "", "i")
+        self.tracer.instant(name)
 
     def activity(self, name: str):
         """One-shot marker used by the eager op layer."""
         self.instant(name)
 
     def dropped_events(self) -> int:
-        with self._lock:
-            return self._writer.dropped()
+        return self._writer.dropped()
 
     @contextmanager
     def context(self, tensor_name: str, activity: str):
@@ -151,11 +187,19 @@ class Timeline:
             self.end_activity(tensor_name)
 
     def close(self):
-        with self._lock:
-            if self._closed:
-                return
-            self._closed = True
-            self._writer.close()
+        if self._closed:
+            return
+        self._closed = True
+        self.tracer.remove_sink(self._writer)
+        dropped = self._writer.dropped()
+        self._writer.close()
+        if _obs_registry.enabled():
+            # flush the final drop count where a dashboard can see it —
+            # a saturated writer must not be silently lossy
+            _obs_registry.get_registry().gauge(
+                "bf_timeline_dropped_events",
+                "events the timeline writer dropped (saturated queue/ring)",
+                rank=self.rank).set(dropped)
 
 
 _timeline: Optional[Timeline] = None
@@ -166,10 +210,20 @@ def get_timeline() -> Optional[Timeline]:
 
 
 def start_timeline(path: str, rank: int = 0) -> Timeline:
+    """Open the Chrome-trace file and attach it to the process-global
+    tracer: from here on, every published span/instant streams to
+    ``<path><rank>.json`` until :func:`stop_timeline`.
+
+    Under ``BLUEFOG_OBSERVE=0`` (checked at start time) the timeline
+    binds a PRIVATE tracer instead — span producers fall back to it
+    (``observe.tracer.effective_tracer``), so ``BLUEFOG_TIMELINE``
+    alone still records the file while the observe layer's global
+    buffers and exporters stay empty, honoring the opt-out."""
     global _timeline
     if _timeline is not None:
         _timeline.close()
-    _timeline = Timeline(path, rank)
+    tracer = _obs_tracer.get_tracer() if _obs_registry.enabled() else None
+    _timeline = Timeline(path, rank, tracer=tracer)
     return _timeline
 
 

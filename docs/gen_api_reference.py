@@ -56,6 +56,16 @@ MODULES = [
      "FIFO admission, deadlines, backpressure"),
     ("bluefog_tpu.serving.metrics",
      "serving metrics (TTFT, tokens/s) + request timeline spans"),
+    ("bluefog_tpu.observe",
+     "unified observability: metrics, spans, step profiles, exporters"),
+    ("bluefog_tpu.observe.registry",
+     "metrics registry: counters, gauges, windowed histograms"),
+    ("bluefog_tpu.observe.tracer",
+     "span tracer: nested spans, instants, per-thread tracks"),
+    ("bluefog_tpu.observe.stepprof",
+     "HLO-attributed step profiler (profile_step / StepProfile)"),
+    ("bluefog_tpu.observe.export",
+     "exporters: Prometheus text, JSONL events, Chrome trace, snapshot"),
     ("bluefog_tpu.parallel.collectives",
      "XLA collective data plane (mesh ops)"),
     ("bluefog_tpu.parallel.ring_attention", "ring/blockwise attention (SP)"),

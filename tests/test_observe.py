@@ -1,0 +1,514 @@
+"""Observability subsystem (bluefog_tpu/observe/).
+
+Contracts under test:
+
+* registry semantics — counter/gauge/histogram behavior, labeled
+  families, one-kind-per-name, snapshot/reset;
+* tracer — span nesting per track, instants, the sink protocol, the
+  Chrome-trace round trip through the timeline file writer;
+* step profiler — ``profile_step`` agrees with the ``benchutil``
+  primitives it promotes (FLOPs = ``compiled_step_flops``, bytes =
+  ``hlo_collective_bytes``) and, on the bucketed overlap step, its
+  per-collective windows reproduce ``overlap_accounting``'s numbers
+  exactly (the acceptance self-consistency bar);
+* the zero-cost guarantee — enabling observability leaves compiled
+  programs untouched: identical jit cache sizes and bit-identical
+  train-step outputs with ``BLUEFOG_OBSERVE`` on vs off;
+* ``BLUEFOG_OBSERVE=0`` stops every built-in publisher;
+* the timeline drop contract — a saturated Python writer queue reports
+  a nonzero drop count (and ``close()`` flushes it to the registry)
+  instead of losing events silently;
+* ``BLUEFOG_LOG_FORMAT=json`` emits parseable one-object-per-line logs.
+"""
+
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bluefog_tpu import benchutil as BU
+from bluefog_tpu import observe
+from bluefog_tpu.observe import (MetricsRegistry, Tracer, percentile,
+                                 profile_step)
+
+pytestmark = pytest.mark.observe
+
+N = 8
+
+
+@pytest.fixture
+def registry():
+    """A fresh, isolated registry (the global one keeps accumulating
+    across the suite — tests that read the global assert deltas)."""
+    return MetricsRegistry()
+
+
+# --------------------------------------------------------------------- #
+# registry
+# --------------------------------------------------------------------- #
+def test_counter_gauge_histogram_semantics(registry):
+    c = registry.counter("reqs_total", "requests")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = registry.gauge("depth")
+    g.set(7)
+    g.inc()
+    g.dec(3)
+    assert g.value == 5.0
+
+    h = registry.histogram("lat", window=4)
+    for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+        h.observe(v)
+    # lifetime totals see everything; percentiles only the window
+    assert h.count == 5 and h.sum == 110.0
+    assert h.window_values == [2.0, 3.0, 4.0, 100.0]
+    assert h.percentile(50) == percentile([2.0, 3.0, 4.0, 100.0], 50)
+
+
+def test_labeled_families_and_kind_conflict(registry):
+    a = registry.counter("ops", op="allreduce")
+    b = registry.counter("ops", op="broadcast")
+    assert a is not b
+    assert registry.counter("ops", op="allreduce") is a  # same child
+    with pytest.raises(ValueError):
+        registry.gauge("ops")  # a name is bound to one kind
+    a.inc(3)
+    snap = registry.snapshot()
+    assert {tuple(r["labels"].items()): r["value"]
+            for r in snap["ops"]} == {(("op", "allreduce"),): 3.0,
+                                      (("op", "broadcast"),): 0.0}
+    registry.reset()
+    assert registry.snapshot() == {}
+
+
+def test_percentile_moved_and_reexported():
+    """The promoted helper IS the serving module's percentile (backward
+    compat for serving/metrics.py importers)."""
+    from bluefog_tpu.serving.metrics import percentile as serving_pct
+
+    assert serving_pct is percentile
+    assert percentile([], 99) == 0.0
+    assert percentile([1.0, None, 3.0], 50) == 2.0
+
+
+# --------------------------------------------------------------------- #
+# tracer
+# --------------------------------------------------------------------- #
+def test_tracer_span_nesting_and_instants():
+    clock = iter(float(i) for i in range(100))
+    tr = Tracer(clock=lambda: next(clock))
+    tr.begin("t0", "outer")
+    assert tr.open_depth("t0") == 1
+    with tr.span("t0", "inner"):
+        assert tr.open_depth("t0") == 2
+        tr.instant("mark", track="t0")
+    tr.end("t0")
+    assert tr.open_depth("t0") == 0
+    phases = [e[0] for e in tr.events()]
+    assert phases == ["B", "B", "i", "E", "E"]
+    ts = [e[3] for e in tr.events()]
+    # microseconds since construction (t0 ate the clock's first tick),
+    # strictly increasing under the injected clock
+    assert ts == [1e6, 2e6, 3e6, 4e6, 5e6]
+
+
+def test_tracer_per_thread_tracks():
+    tr = Tracer()
+    done = threading.Event()
+
+    def worker():
+        with tr.span(None, "work"):  # track = thread name
+            done.set()
+
+    t = threading.Thread(target=worker, name="worker-7")
+    t.start()
+    t.join()
+    assert done.is_set()
+    tracks = {e[2] for e in tr.events()}
+    assert "worker-7" in tracks
+
+
+def test_tracer_ring_buffer_bounds_memory():
+    tr = Tracer(max_events=8)
+    for i in range(20):
+        tr.instant(f"e{i}")
+    assert len(tr.events()) == 8
+    assert tr.dropped_events == 12
+    assert tr.events()[0][1] == "e12"  # oldest fell off first
+
+
+def test_chrome_trace_round_trip(tmp_path):
+    """Spans published through a tracer stream to the timeline file
+    sink AND serialize identically from the in-memory buffer — the
+    thin-exporter contract timeline.py now has."""
+    from bluefog_tpu.timeline import Timeline
+
+    tl = Timeline(str(tmp_path / "tl"), rank=2, use_native=False)
+    tl.tracer.begin("tensor_a", "ENQUEUE")
+    tl.tracer.end("tensor_a")
+    tl.tracer.instant("neighbor_allreduce")
+    tl.close()
+    file_events = json.loads((tmp_path / "tl2.json").read_text())
+    mem_events = tl.tracer.to_chrome_trace()
+    assert [e["ph"] for e in file_events] == [e["ph"] for e in mem_events]
+    assert [e.get("name") for e in file_events] == \
+        [e.get("name") for e in mem_events]
+    # round trip: serialize the in-memory view, parse it back
+    parsed = json.loads(json.dumps(mem_events))
+    assert parsed[0] == {"name": "ENQUEUE", "cat": "tensor_a", "ph": "B",
+                         "ts": parsed[0]["ts"], "pid": 2,
+                         "tid": "tensor_a"}
+
+
+def test_timeline_reports_saturated_queue_drops(tmp_path, monkeypatch):
+    """A wedged/slow writer must surface as a DROP COUNT, not silent
+    loss: block the file behind an event, saturate the bounded queue,
+    and check dropped_events() plus the registry gauge close() flushes."""
+    monkeypatch.setenv("BLUEFOG_TIMELINE_QUEUE_CAPACITY", "8")
+    from bluefog_tpu.timeline import Timeline
+
+    tl = Timeline(str(tmp_path / "sat"), rank=0, use_native=False)
+    release = threading.Event()
+    real_file = tl._writer._file
+
+    class _BlockingFile:
+        def write(self, s):
+            release.wait(timeout=10.0)
+            return real_file.write(s)
+
+        def flush(self):
+            real_file.flush()
+
+        def close(self):
+            real_file.close()
+
+    tl._writer._file = _BlockingFile()
+    for i in range(64):  # writer blocked -> queue (cap 8) must overflow
+        tl.instant(f"burst{i}")
+    assert tl.dropped_events() > 0
+    release.set()
+    observe.get_registry().reset()
+    tl.close()
+    gauge = observe.get_registry().gauge("bf_timeline_dropped_events",
+                                         rank=0)
+    assert gauge.value == tl.dropped_events() > 0
+
+
+def test_timeline_under_opt_out_stays_private(tmp_path, monkeypatch):
+    """BLUEFOG_OBSERVE=0 + BLUEFOG_TIMELINE: the file still records
+    (producers fall back to the timeline's PRIVATE tracer via
+    effective_tracer) but the observe layer's global tracer buffers
+    stay empty — the opt-out is honored."""
+    from bluefog_tpu import timeline as timeline_mod
+    from bluefog_tpu.observe.tracer import effective_tracer
+
+    monkeypatch.setenv("BLUEFOG_OBSERVE", "0")
+    monkeypatch.setenv("BLUEFOG_TIMELINE_NATIVE", "0")
+    global_before = len(observe.get_tracer().events())
+    tl = timeline_mod.start_timeline(str(tmp_path / "priv"))
+    try:
+        assert tl.tracer is not observe.get_tracer()
+        tr = effective_tracer(timeline_mod.get_timeline())
+        assert tr is tl.tracer  # the documented fallback
+        with tr.span("track", "SPAN_UNDER_OPTOUT"):
+            pass
+    finally:
+        timeline_mod.stop_timeline()
+    assert "SPAN_UNDER_OPTOUT" in (tmp_path / "priv0.json").read_text()
+    assert len(observe.get_tracer().events()) == global_before
+
+
+# --------------------------------------------------------------------- #
+# step profiler
+# --------------------------------------------------------------------- #
+def test_profile_step_matches_benchutil_primitives():
+    """profile_step IS the promoted benchutil machinery: FLOPs equal
+    compiled_step_flops, collective bytes equal hlo_collective_bytes of
+    the same compiled module."""
+    mesh = Mesh(np.array(jax.devices()[:N]), ("bf",))
+
+    def f(x):
+        return jax.lax.psum(x @ x, "bf")
+
+    sm = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("bf"),
+                               out_specs=P(), check_vma=False))
+    x = jnp.ones((N, 16, 16), jnp.float32)
+    prof = profile_step(sm, x, name="toy", publish=False)
+    assert prof.flops == BU.compiled_step_flops(sm, x) > 0
+    hlo = sm.lower(x).compile().as_text()
+    assert prof.collective_bytes == BU.hlo_collective_bytes(hlo)
+    assert "all-reduce" in prof.collective_bytes
+    d = prof.to_dict()
+    json.dumps(d)  # JSON-ready
+    assert d["flops"] == prof.flops and "mfu" in d
+
+
+def _bucketed_step(mesh, K=4):
+    from bluefog_tpu.optim import functional as F
+    from bluefog_tpu.topology.dynamic import one_peer_dynamic_schedule
+
+    base = {f"w{i}": jnp.eye(16) * 0.5 for i in range(4)}
+    base.update({f"b{i}": jnp.zeros((16,)) for i in range(4)})
+
+    def loss_fn(params, batch):
+        h = batch
+        for i in range(4):
+            h = jnp.tanh(h @ params[f"w{i}"] + params[f"b{i}"])
+        return jnp.mean((h - 1.0) ** 2)
+
+    opt = optax.sgd(0.05)
+    step = F.build_train_step(
+        loss_fn, opt, mesh, comm_mode="atc",
+        topology=one_peer_dynamic_schedule(N)[0], overlap="bucketed",
+        overlap_buckets=K, donate=False)
+    params = F.rank_major(base, mesh)
+    ostate = F.rank_major(opt.init(base), mesh)
+    batch = jax.device_put(
+        np.zeros((N, 8, 16)), NamedSharding(mesh, P("bf")))
+    return step, params, ostate, batch
+
+
+def test_profile_step_reproduces_overlap_accounting():
+    """Acceptance: on the bucketed overlap step, the profiler's
+    per-collective transfer windows reproduce overlap_accounting's
+    numbers — same windows, same per-kind byte totals, same
+    byte-weighted fraction."""
+    mesh = Mesh(np.array(jax.devices()[:N]), ("bf",))
+    step, params, ostate, batch = _bucketed_step(mesh, K=4)
+    peak, link = 1e6, 1e12
+    prof = profile_step(step, params, ostate, batch, jnp.int32(0),
+                        name="bucketed", publish=False,
+                        peak_flops=peak, link_bytes_per_s=link,
+                        hbm_bytes_per_s=0.0)
+    hlo = step.lower(params, ostate, batch, jnp.int32(0)) \
+        .compile().as_text()
+    acc = BU.overlap_accounting(hlo, peak_flops_per_s=peak,
+                                link_bytes_per_s=link)
+    assert prof.overlap["windows"] == acc["windows"]
+    assert prof.overlap["per_kind"] == acc["per_kind"]
+    assert prof.overlap["fraction"] == acc["fraction"] == 1.0
+    # the profile's window list is the full module view the accounting
+    # filtered from
+    permutes = [w for w in prof.windows
+                if w["kind"] == "collective-permute"]
+    assert len(permutes) >= 4
+    assert sum(w["bytes"] for w in permutes) == \
+        prof.collective_bytes["collective-permute"]["bytes"] == \
+        acc["bytes_total"]
+
+
+def test_observe_toggle_leaves_compiled_programs_untouched(monkeypatch):
+    """Acceptance: identical jit cache sizes and bit-identical
+    train-step outputs with BLUEFOG_OBSERVE on vs off."""
+    mesh = Mesh(np.array(jax.devices()[:N]), ("bf",))
+    step, params0, ostate0, batch = _bucketed_step(mesh)
+
+    def run3():
+        p, o = params0, ostate0
+        for i in range(3):
+            p, o, loss = step(p, o, batch, jnp.int32(i))
+        return p, loss
+
+    monkeypatch.setenv("BLUEFOG_OBSERVE", "1")
+    p_on, loss_on = run3()
+    size_on = step.jitted._cache_size()
+    monkeypatch.setenv("BLUEFOG_OBSERVE", "0")
+    p_off, loss_off = run3()
+    assert step.jitted._cache_size() == size_on  # no recompiles either way
+    np.testing.assert_array_equal(np.asarray(loss_on),
+                                  np.asarray(loss_off))
+    for a, b in zip(jax.tree.leaves(p_on), jax.tree.leaves(p_off)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_train_step_publishes_and_opt_out(monkeypatch):
+    """The built step reports dispatches (counter + span) by default;
+    BLUEFOG_OBSERVE=0 silences it."""
+    mesh = Mesh(np.array(jax.devices()[:N]), ("bf",))
+    step, params, ostate, batch = _bucketed_step(mesh)
+    ctr = observe.get_registry().counter(
+        "bf_train_steps_total", comm_mode="atc", overlap="bucketed",
+        guarded="false")
+    before = ctr.value
+    monkeypatch.setenv("BLUEFOG_OBSERVE", "1")
+    step(params, ostate, batch, jnp.int32(0))
+    assert ctr.value == before + 1
+    monkeypatch.setenv("BLUEFOG_OBSERVE", "0")
+    step(params, ostate, batch, jnp.int32(1))
+    assert ctr.value == before + 1  # publication stopped
+
+
+def test_serving_metrics_publish_and_opt_out(monkeypatch):
+    """ServingMetrics rides the registry (isolated here via registry=)
+    and the summary dict keeps its original shape; with
+    BLUEFOG_OBSERVE=0 and no explicit registry nothing is published."""
+    from bluefog_tpu.serving.metrics import ServingMetrics
+
+    reg = MetricsRegistry()
+    m = ServingMetrics(registry=reg)
+    m.on_submit(1, 0.0)
+    m.on_admit(1, 0.5)
+    m.on_first_token(1, 1.0)
+    m.on_token(1, 1.25)
+    m.on_retire(1, 1.5, "completed")
+    m.on_step(0.5, 3)
+    snap = reg.snapshot()
+    assert snap["bf_serving_requests_total"][0]["value"] == 1.0
+    assert snap["bf_serving_tokens_total"][0]["value"] == 2.0
+    assert snap["bf_serving_ttft_seconds"][0]["count"] == 1
+    assert snap["bf_serving_ttft_seconds"][0]["p50"] == 1.0
+    assert snap["bf_serving_retired_total"][0]["labels"] == \
+        {"outcome": "completed"}
+    assert snap["bf_serving_queue_depth"][0]["value"] == 3.0
+    s = m.summary()
+    assert s["n_finished"] == 1 and s["tokens_generated"] == 2
+    assert set(s) == {
+        "n_requests", "n_finished", "n_rejected", "outcomes",
+        "tokens_generated", "tokens_per_sec", "ttft_p50", "ttft_p99",
+        "latency_p50", "latency_p99", "mean_slot_occupancy",
+        "mean_queue_depth", "max_queue_depth"}
+
+    monkeypatch.setenv("BLUEFOG_OBSERVE", "0")
+    global_before = observe.get_registry().snapshot()
+    m2 = ServingMetrics()
+    m2.on_submit(2, 0.0)
+    m2.on_reject(3, 0.0)
+    assert observe.get_registry().snapshot() == global_before
+    assert m2.summary()["n_rejected"] == 1  # the summary still works
+
+
+def test_run_resilient_publishes_events(tmp_path):
+    """The resilience runner's event stream lands in the registry as
+    bf_resilience_events_total{kind=} and per-rank skip counters."""
+    import bluefog_tpu.resilience as R
+    from bluefog_tpu.checkpoint import Checkpointer
+    from bluefog_tpu.optim import functional as F
+    from bluefog_tpu.topology.dynamic import one_peer_dynamic_schedule
+
+    mesh = Mesh(np.array(jax.devices()[:N]), ("bf",))
+    sched = one_peer_dynamic_schedule(N)
+    base = {"w": jnp.eye(4)}
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch @ params["w"]) ** 2)
+
+    opt = optax.sgd(0.05)
+    step = F.build_train_step(loss_fn, opt, mesh, comm_mode="cta",
+                              schedule=sched, donate=False,
+                              guard=F.GuardConfig(max_consecutive_bad=3))
+    params = F.rank_major(base, mesh)
+    ostate = F.rank_major(opt.init(base), mesh)
+
+    def batch_fn(step_i):
+        return jax.device_put(np.ones((N, 2, 4), np.float32),
+                              NamedSharding(mesh, P("bf")))
+
+    plan = R.FaultPlan.nan_burst(N, rank=1, step=2, duration=2)
+    reg = observe.get_registry()
+    ck_before = reg.counter("bf_resilience_events_total",
+                            kind="checkpoint").value
+    sk_before = reg.counter("bf_resilience_skips_total", rank=1).value
+    ck = Checkpointer(str(tmp_path / "ck"))
+    res = R.run_resilient(step, params, ostate, batch_fn, steps=6,
+                          checkpointer=ck, mesh=mesh, schedule=sched,
+                          fault_plan=plan, checkpoint_every=5,
+                          sleep=lambda s: None)
+    ck.close()
+    assert res.total_skips[1] == 2
+    assert reg.counter("bf_resilience_events_total",
+                       kind="checkpoint").value > ck_before
+    assert reg.counter("bf_resilience_skips_total",
+                       rank=1).value == sk_before + 2
+
+
+# --------------------------------------------------------------------- #
+# exporters
+# --------------------------------------------------------------------- #
+def test_prometheus_text_format(registry):
+    registry.counter("bf_reqs_total", "requests", op="a").inc(2)
+    registry.gauge("bf_depth", "queue depth").set(4)
+    h = registry.histogram("bf_lat", "latency")
+    h.observe(1.0)
+    h.observe(3.0)
+    text = observe.prometheus_text(registry)
+    lines = text.strip().splitlines()
+    assert "# TYPE bf_reqs_total counter" in lines
+    assert 'bf_reqs_total{op="a"} 2.0' in lines
+    assert "bf_depth 4.0" in lines
+    assert "# TYPE bf_lat summary" in lines
+    assert "bf_lat_count 2" in lines
+    assert "bf_lat_sum 4.0" in lines
+    assert 'bf_lat{quantile="0.5"} 2.0' in lines
+
+
+def test_jsonl_and_snapshot(tmp_path):
+    tr = Tracer()
+    with tr.span("track", "phase"):
+        tr.instant("tick", track="track")
+    text = observe.jsonl_events(tr)
+    objs = [json.loads(ln) for ln in text.splitlines()]
+    assert [o["ph"] for o in objs] == ["B", "i", "E"]
+    assert objs[0]["name"] == "phase" and objs[0]["track"] == "track"
+
+    snap = observe.snapshot(str(tmp_path / "dump"))
+    assert "metrics" in snap and "trace" in snap
+    assert sorted(snap["files"]) == ["events.jsonl", "metrics.prom",
+                                     "trace.json"]
+    json.loads((tmp_path / "dump" / "trace.json").read_text())
+
+
+def test_engine_profile_emits_step_profiles():
+    """ServingEngine.profile(): HLO-attributed StepProfiles of the two
+    resident programs, FLOPs from XLA's own cost analysis."""
+    from bluefog_tpu import models
+    from bluefog_tpu.serving import ServingEngine
+
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32)
+    variables = models.Llama(cfg).init(jax.random.PRNGKey(1),
+                                       jnp.zeros((2, 4), jnp.int32))
+    eng = ServingEngine(variables, cfg, capacity=2, max_len=16,
+                        prefill_chunk=4)
+    profs = eng.profile(publish=False)
+    assert set(profs) == {"prefill_chunk", "decode_step"}
+    assert profs["decode_step"].flops > 0
+    assert profs["prefill_chunk"].flops > 0
+    json.dumps({k: p.to_dict() for k, p in profs.items()})
+
+
+# --------------------------------------------------------------------- #
+# structured logging
+# --------------------------------------------------------------------- #
+def test_json_log_format(monkeypatch, capsys):
+    """BLUEFOG_LOG_FORMAT=json: one JSON object per line with
+    rank/timestamp/level."""
+    import bluefog_tpu.logging_util as LU
+
+    monkeypatch.setenv("BLUEFOG_LOG_FORMAT", "json")
+    monkeypatch.setenv("BLUEFOG_TPU_PROCESS_ID", "3")
+    monkeypatch.setattr(LU, "_logger", None)  # rebuild with the env
+    logger = LU.get_logger()
+    try:
+        logger.warning("queue %s is full", "prefill")
+        err = capsys.readouterr().err
+    finally:
+        for h in list(logger.handlers):
+            logger.removeHandler(h)
+        monkeypatch.setattr(LU, "_logger", None)
+    line = [ln for ln in err.splitlines() if ln.strip()][-1]
+    obj = json.loads(line)
+    assert obj["level"] == "WARNING"
+    assert obj["rank"] == 3
+    assert obj["msg"] == "queue prefill is full"
+    assert obj["logger"] == "bluefog_tpu"
+    assert isinstance(obj["ts"], float)
