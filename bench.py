@@ -11,9 +11,20 @@ multi-chip (bluefog_tpu.optim.functional) on however many chips are
 attached (driver: one v5e chip), with train-mode batch norm, bf16 compute.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``--compare PREV.json`` turns the run into a regression gate: headline
+throughput/MFU fields are compared against a prior record (a raw line
+or a driver ``BENCH_*.json`` wrapper) with a per-metric relative
+tolerance (``--tolerance``, default 5%); a regression prints the delta
+table and exits nonzero.  ``--out`` additionally writes the fresh
+record to a file, so the next run has something to gate against —
+SKIPPED when the gate fails, so a regressed run can never overwrite
+the baseline it was gated against.
 """
 
+import argparse
 import json
+import sys
 import time
 
 import numpy as np
@@ -28,7 +39,20 @@ TIMED_STEPS = 10
 TIMED_WINDOWS = 3  # report the median window (tunnel hiccups skew means)
 
 
-def main():
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--compare", metavar="PREV.json", default=None,
+                    help="gate this run against a prior bench record; "
+                         "exits 1 on regression beyond --tolerance")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="per-metric relative regression tolerance")
+    ap.add_argument("--out", default=None,
+                    help="also write the fresh record to this JSON file")
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
     import jax
     import jax.numpy as jnp
     import optax
@@ -122,7 +146,7 @@ def main():
         if total_img_per_sec else 0.0
     achieved_mfu = mfu(flops_per_step, step_seconds, peak_per_chip=None) \
         if step_seconds else 0.0
-    print(json.dumps({
+    record = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "img/s/chip",
@@ -130,8 +154,25 @@ def main():
         "mfu": round(achieved_mfu, 4),
         "flops_per_step_per_device": flops_per_step,
         "peak_tflops_per_chip": chip_peak_flops() / 1e12,
-    }))
+    }
+    print(json.dumps(record))
+    # gate BEFORE writing --out: with the rolling-baseline usage
+    # (--compare BASE.json --out BASE.json) a regressed run must not
+    # overwrite the good baseline and ratchet the regression through
+    if args.compare:
+        from bluefog_tpu.benchutil import bench_regression_gate
+
+        if not bench_regression_gate(record, args.compare,
+                                     tolerance=args.tolerance):
+            if args.out:
+                print(f"[bench-gate] regression: NOT writing {args.out}")
+            return 1
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(record, fh, indent=1)
+            fh.write("\n")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -3,9 +3,13 @@
 Round-8 evidence for the resilience subsystem (ISSUE 3): the same
 guarded one-compiled-program train step survives a NaN burst, a rank
 death, and the subsequent heal + rollback, and the surviving ranks keep
-converging — measured, not asserted.
+converging — measured, not asserted.  Round 10 (ISSUE 5) adds the
+injected-STRAGGLER scenario: one rank runs slow, the fleet telemetry
+layer's ``StragglerDetector`` must NAME it from the per-rank step-time
+vector within a bounded number of steps (patience + 1), with no false
+flags — the detection latency is a machine-checked claim in the JSON.
 
-Two parts, one JSON artifact (wire_quant_consensus_r05.json style):
+Three parts, one JSON artifact (wire_quant_consensus_r05.json style):
 
 1. **Healed-mixing simulation** (pure numpy, no devices): kill ranks in
    the one-peer exponential-2 schedule at n=32, heal, and trace the
@@ -19,6 +23,12 @@ Two parts, one JSON artifact (wire_quant_consensus_r05.json style):
    checkpointing, vs the same data with no faults and no guard.
    Reported: final mean loss both sides, skip counts, rollbacks,
    recompiles (must be 0 across the whole chaotic run), wall time.
+3. **Injected straggler** (8 CPU 'ranks'): the same guarded training
+   with a ``FaultPlan.straggler`` stalling one rank per step; the
+   per-rank step-time vector (measured wall + the plan's per-rank
+   stall — what each process would gossip in a real fleet) feeds the
+   ``StragglerDetector`` through ``run_resilient``.  Reported: the
+   flag step, detection latency vs the bound, z-scores, false flags.
 
 Run (CPU, no TPU): JAX_PLATFORMS=cpu python benchmarks/chaos_resilience.py
 """
@@ -167,6 +177,96 @@ def chaos_run(steps: int, seed: int) -> dict:
     }
 
 
+def straggler_scenario(steps: int, seed: int) -> dict:
+    """Part 3: one slow rank must be NAMED by the gossip-fed detector.
+
+    The straggler's extra per-step latency rides the fault plan's STALL
+    schedule; ``step_times_fn`` synthesizes the per-rank vector each
+    process would gossip (measured wall + its injected stall) while the
+    injected ``sleep`` is a no-op so the bench itself stays fast."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    from bluefog_tpu import resilience as R
+    from bluefog_tpu.checkpoint import Checkpointer
+    from bluefog_tpu.observe.fleet import StragglerDetector
+    from bluefog_tpu.optim import functional as F
+    from bluefog_tpu.topology import one_peer_dynamic_schedule
+
+    mesh = Mesh(np.array(jax.devices()[:N]), ("bf",))
+    sched = one_peer_dynamic_schedule(N)
+    dim, width = 16, 4
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(dim, width)
+    xs = rng.randn(64, N, 8, dim)
+    ys = xs @ w_true + 0.01 * rng.randn(64, N, 8, width)
+
+    def batch_fn(step):
+        return (xs[step % 64], ys[step % 64])
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    opt = optax.sgd(0.05, momentum=0.9)
+    step_g = F.build_train_step(loss_fn, opt, mesh, comm_mode="atc",
+                                schedule=sched, guard=F.GuardConfig())
+    params = F.rank_major({"w": jnp.zeros((dim, width))}, mesh)
+    opt_state = F.rank_major(opt.init({"w": jnp.zeros((dim, width))}),
+                             mesh)
+
+    slow_rank, onset = 3, max(4, steps // 4)
+    stall_s = 0.25  # far above CPU step noise -> a clean z outlier
+    plan = R.FaultPlan.straggler(N, slow_rank, onset,
+                                 duration=steps - onset,
+                                 stall_seconds=stall_s)
+    patience = 3
+    det = StragglerDetector(N, z_threshold=4.0, patience=patience)
+    fdet = R.FailureDetector(N)
+    events = []
+
+    def step_times_fn(step, wall):
+        return wall + plan.stall_seconds_by_rank(step)
+
+    import tempfile
+
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory() as d:
+        ck = Checkpointer(d)
+        res = R.run_resilient(
+            step_g, params, opt_state, batch_fn, steps=steps,
+            checkpointer=ck, mesh=mesh, schedule=sched,
+            fault_plan=plan, detector=fdet, checkpoint_every=0,
+            sleep=lambda s: None, straggler=det,
+            step_times_fn=step_times_fn,
+            on_event=events.append)
+        ck.close()
+    wall_s = time.monotonic() - t0
+
+    flags = [e for e in events if e.kind == "straggler"]
+    flag_step = flags[0].step if flags else None
+    flagged_ranks = sorted({r for e in flags for r in e.detail["ranks"]})
+    latency = (flag_step - onset + 1) if flag_step is not None else None
+    bound = patience + 1
+    return {
+        "steps": steps,
+        "slow_rank": slow_rank,
+        "onset_step": onset,
+        "stall_seconds": stall_s,
+        "patience": patience,
+        "flag_step": flag_step,
+        "flagged_ranks": flagged_ranks,
+        "detection_latency_steps": latency,
+        "detection_bound_steps": bound,
+        "failure_detector_suspects": fdet.external_suspects(),
+        "skips_per_rank": [int(v) for v in res.total_skips],
+        "n_rollbacks": res.n_rollbacks,
+        "wall_s": wall_s,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=60)
@@ -174,11 +274,12 @@ def main():
                     help="payload width of the mixing simulation")
     ap.add_argument("--sim-rounds", type=int, default=400)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--out", default="benchmarks/chaos_resilience_r08.json")
+    ap.add_argument("--out", default="benchmarks/chaos_resilience_r10.json")
     args = ap.parse_args()
 
     sim = simulate(args.sim_rounds, args.dim, args.seed)
     chaos = chaos_run(args.steps, args.seed)
+    strag = straggler_scenario(args.steps, args.seed)
 
     checks = {
         # healing keeps the surviving ranks contracting...
@@ -197,6 +298,15 @@ def main():
         "chaos_loss_comparable": (
             chaos["final_loss_live_mean_chaos"]
             < 10 * max(chaos["final_loss_live_mean_faultfree"], 1e-9)),
+        # the injected straggler is NAMED within the bounded latency,
+        # with no false flags and the suspicion wired to the detector
+        "straggler_flagged": strag["flagged_ranks"] == [strag["slow_rank"]],
+        "straggler_latency_bounded": (
+            strag["detection_latency_steps"] is not None
+            and strag["detection_latency_steps"]
+            <= strag["detection_bound_steps"]),
+        "straggler_feeds_suspects": (
+            strag["failure_detector_suspects"] == [strag["slow_rank"]]),
     }
     for k, ok in checks.items():
         print(f"[check] {k}: {'OK' if ok else 'FAILED'}")
@@ -204,6 +314,7 @@ def main():
     out = {
         "simulation": sim,
         "chaos": chaos,
+        "straggler": strag,
         "checks": {k: bool(v) for k, v in checks.items()},
     }
     with open(args.out, "w") as fh:

@@ -70,6 +70,11 @@ parser.add_argument("--dim", type=int, default=256,
                     "measures the host loop, not batching policy)")
 parser.add_argument("--layers", type=int, default=6)
 parser.add_argument("--out", default="serving_bench_r07.json")
+parser.add_argument("--compare", metavar="PREV.json", default=None,
+                    help="regression gate: compare headline throughput/"
+                    "p99 fields against a prior record; exit 1 beyond "
+                    "--tolerance")
+parser.add_argument("--tolerance", type=float, default=0.05)
 
 
 def make_trace(args):
@@ -220,11 +225,23 @@ def main():
         "speedup_tokens_per_sec":
             cont["tokens_per_sec"] / stat["tokens_per_sec"],
     }
+    print(json.dumps(rec, indent=2))
+    # gate BEFORE writing --out so a regressed run can never clobber
+    # the record it was gated against (rolling-baseline usage)
+    if args.compare:
+        from bluefog_tpu.benchutil import bench_regression_gate
+
+        if not bench_regression_gate(rec, args.compare,
+                                     tolerance=args.tolerance):
+            print(f"[bench-gate] regression: NOT writing {args.out}")
+            return 1
     with open(args.out, "w") as f:
         json.dump(rec, f, indent=2)
         f.write("\n")
-    print(json.dumps(rec, indent=2))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(main())

@@ -21,7 +21,8 @@ __all__ = ["device_fetch", "fetch_overhead", "timed",
            "chip_peak_flops", "chip_hbm_bandwidth", "compiled_step_flops",
            "mfu", "hlo_collective_bytes", "hlo_op_breakdown",
            "scheduled_collective_windows", "overlap_accounting",
-           "LATENCY_HIDING_XLA_FLAGS", "latency_hiding_xla_flags"]
+           "LATENCY_HIDING_XLA_FLAGS", "latency_hiding_xla_flags",
+           "bench_headline", "bench_compare", "bench_regression_gate"]
 
 # Dense bf16 peak FLOP/s per chip, from published TPU specs.  Keyed by
 # substrings of jax's ``device_kind``; override with BLUEFOG_CHIP_PEAK_TFLOPS
@@ -668,3 +669,95 @@ def fwd_bwd_time(f, params, x0, n=20, reps=3):
         device_fetch(chained(params, x0)[..., :1])
         times.append((time.perf_counter() - t0 - ov) / n)
     return float(np.median(times))
+
+
+# --------------------------------------------------------------------- #
+# bench regression gate (ISSUE 5 satellite): compare a fresh run's
+# headline numbers against a prior BENCH_*.json — per-metric tolerance,
+# one-line delta table, nonzero exit on regression.  The BENCH
+# trajectory was previously unaggregated; this makes each run a gate.
+# --------------------------------------------------------------------- #
+# headline fields worth gating, with their GOOD direction
+_HEADLINE_HIGHER = ("value", "mfu", "tokens_per_sec", "useful_tokens",
+                    "speedup_tokens_per_sec", "vs_baseline")
+_HEADLINE_LOWER = ("ttft_p50", "ttft_p99", "latency_p50", "latency_p99",
+                   "makespan_s", "p99", "p50")
+
+
+def bench_headline(record: dict) -> dict:
+    """Extract the gateable headline metrics of a bench JSON record as
+    ``{name: float}``.  Understands the three shapes this repo emits:
+    the raw ``bench.py`` line (``{"metric", "value", "mfu", ...}``),
+    the driver's ``BENCH_*.json`` wrapper (same dict under
+    ``"parsed"``), and section records like ``serving_bench``'s
+    (headline fields under ``"continuous"``)."""
+    if isinstance(record.get("parsed"), dict):
+        record = record["parsed"]
+    keys = set(_HEADLINE_HIGHER) | set(_HEADLINE_LOWER)
+    out: dict = {}
+
+    def grab(d: dict, prefix: str) -> None:
+        for k, v in d.items():
+            if (k in keys and isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                out[prefix + k] = float(v)
+
+    grab(record, "")
+    for section in ("continuous", "static", "chaos", "straggler"):
+        if isinstance(record.get(section), dict):
+            grab(record[section], section + ".")
+    return out
+
+
+def _direction(name: str) -> int:
+    """+1 = higher is better, -1 = lower is better (latency tails)."""
+    base = name.rsplit(".", 1)[-1]
+    return -1 if base in _HEADLINE_LOWER else +1
+
+
+def bench_compare(current: dict, previous: dict, tolerance: float = 0.05,
+                  tolerances: dict = None) -> tuple:
+    """Compare two bench records' shared headline metrics.
+
+    Returns ``(ok, rows)``: ``rows`` is one dict per shared metric
+    (``name, prev, cur, delta_frac, tol, regressed``); ``ok`` is False
+    iff any metric moved more than its tolerance in the BAD direction
+    (improvements never fail the gate).  ``tolerances`` overrides the
+    per-metric relative tolerance by headline name."""
+    cur_h = bench_headline(current)
+    prev_h = bench_headline(previous)
+    rows = []
+    ok = True
+    for name in sorted(set(cur_h) & set(prev_h)):
+        prev, cur = prev_h[name], cur_h[name]
+        tol = float((tolerances or {}).get(name, tolerance))
+        denom = max(abs(prev), 1e-12)
+        delta = (cur - prev) / denom
+        regressed = (-delta if _direction(name) > 0 else delta) > tol
+        ok = ok and not regressed
+        rows.append(dict(name=name, prev=prev, cur=cur,
+                         delta_frac=delta, tol=tol, regressed=regressed))
+    return ok, rows
+
+
+def bench_regression_gate(current: dict, prev_path: str,
+                          tolerance: float = 0.05,
+                          tolerances: dict = None) -> bool:
+    """Gate ``current`` against the record stored at ``prev_path``:
+    prints the one-line delta table and returns False on regression
+    (callers ``sys.exit(1)``)."""
+    import json as _json
+
+    with open(prev_path) as fh:
+        previous = _json.load(fh)
+    ok, rows = bench_compare(current, previous, tolerance, tolerances)
+    if not rows:
+        print(f"[bench-gate] no shared headline metrics with {prev_path}")
+        return True
+    cells = []
+    for r in rows:
+        mark = "REGRESSED" if r["regressed"] else "ok"
+        cells.append(f"{r['name']} {r['prev']:.4g}->{r['cur']:.4g} "
+                     f"({r['delta_frac']:+.1%} {mark})")
+    print(f"[bench-gate] vs {prev_path}: " + " | ".join(cells))
+    return ok

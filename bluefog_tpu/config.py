@@ -16,6 +16,8 @@ __all__ = [
     "log_format",
     "observe",
     "timeline_path",
+    "timeline_flush_every",
+    "straggler_z_threshold",
     "skip_negotiate_default",
     "ops_on_cpu",
     "stall_warning_time",
@@ -59,6 +61,29 @@ def timeline_path() -> str:
     """BLUEFOG_TIMELINE: path prefix for per-process Chrome-trace files
     (reference operations.cc:464-473)."""
     return _env("BLUEFOG_TIMELINE", "")
+
+
+def timeline_flush_every() -> int:
+    """BLUEFOG_TIMELINE_FLUSH_EVERY (default 1024): every this many
+    events drained by the Python timeline writer, the accumulated drop
+    count flushes to the ``bf_timeline_dropped_events`` gauge — a
+    long-running saturated run is visible before shutdown, not only at
+    ``close()``."""
+    try:
+        return max(1, int(_env("BLUEFOG_TIMELINE_FLUSH_EVERY", "1024")))
+    except ValueError:
+        return 1024
+
+
+def straggler_z_threshold() -> float:
+    """BLUEFOG_STRAGGLER_Z (default 4.0): robust step-time z-score above
+    which the fleet telemetry layer's
+    :class:`~bluefog_tpu.observe.fleet.StragglerDetector` counts a rank
+    as slow (flagged after ``patience`` consecutive observations)."""
+    try:
+        return float(_env("BLUEFOG_STRAGGLER_Z", "4.0"))
+    except ValueError:
+        return 4.0
 
 
 def fusion_threshold() -> int:

@@ -8,7 +8,10 @@ comes from ``BLUEFOG_LOG_LEVEL`` with the same names.
 object per line carrying ``ts`` (unix seconds), ``level``, ``logger``,
 ``rank``, and ``msg`` — what a log aggregator ingests without a parse
 rule, and the textual counterpart of the observe subsystem's JSONL
-event log (docs/observability.md).
+event log (docs/observability.md).  When the calling thread is inside
+an open tracer span, the line additionally carries ``span`` and
+``track`` correlation fields, so structured logs JOIN against the
+Chrome trace (grep the log, find the span, load the timeline).
 """
 
 from __future__ import annotations
@@ -34,7 +37,9 @@ _logger = None
 
 
 class _JsonFormatter(logging.Formatter):
-    """One JSON object per record; exceptions fold into ``exc``."""
+    """One JSON object per record; exceptions fold into ``exc``; the
+    calling thread's open tracer span (if any) folds into
+    ``span``/``track`` so the line joins the Chrome trace."""
 
     def format(self, record: logging.LogRecord) -> str:
         obj = {
@@ -44,6 +49,17 @@ class _JsonFormatter(logging.Formatter):
             "rank": int(os.environ.get("BLUEFOG_TPU_PROCESS_ID", "0")),
             "msg": record.getMessage(),
         }
+        try:
+            # lazy import: logging comes up before (and without) the
+            # observe layer; a formatter must never fail a log call
+            from bluefog_tpu.observe.tracer import publish_tracer
+
+            tr = publish_tracer()
+            sp = tr.active_span() if tr is not None else None
+            if sp is not None:
+                obj["track"], obj["span"] = sp
+        except Exception:
+            pass
         if record.exc_info:
             obj["exc"] = self.formatException(record.exc_info)
         return json.dumps(obj)

@@ -17,7 +17,12 @@ subsystem extends that stance to metrics and per-op cost attribution):
   :class:`StepProfile` (FLOPs, per-collective bytes, overlap windows,
   MFU) from XLA's own view of the compiled module;
 * :mod:`~bluefog_tpu.observe.export` — Prometheus text / JSONL event
-  log / Chrome trace, plus the one-call ``bf.observe.snapshot()``.
+  log / Chrome trace, plus the one-call ``bf.observe.snapshot()``;
+* :mod:`~bluefog_tpu.observe.fleet` — decentralized CROSS-RANK
+  aggregation: push-sum gossip of registry metrics over the training
+  topology (``FleetAggregator``), per-edge traffic accounting
+  (``bf_edge_bytes_total{src,dst}``), and the gossip-fed
+  ``StragglerDetector``.
 
 Opt out with ``BLUEFOG_OBSERVE=0`` (publication stops; explicitly-held
 registries/tracers keep working).  See docs/observability.md.
@@ -31,6 +36,10 @@ from bluefog_tpu.observe.stepprof import (StepProfile, hlo_op_breakdown,
                                           profile_step)
 from bluefog_tpu.observe.export import (chrome_trace, jsonl_events,
                                         prometheus_text, snapshot)
+from bluefog_tpu.observe.fleet import (FleetAggregate, FleetAggregator,
+                                       StragglerDetector, collect_local,
+                                       edge_list, push_sum_matrix,
+                                       record_edge_traffic)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "enabled",
@@ -38,4 +47,7 @@ __all__ = [
     "Tracer", "get_tracer", "publish_tracer",
     "StepProfile", "profile_step", "hlo_op_breakdown",
     "prometheus_text", "jsonl_events", "chrome_trace", "snapshot",
+    "FleetAggregate", "FleetAggregator", "StragglerDetector",
+    "collect_local", "edge_list", "push_sum_matrix",
+    "record_edge_traffic",
 ]

@@ -33,6 +33,12 @@ def _prom_escape(v: str) -> str:
     return v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
 
 
+def _prom_help_escape(v: str) -> str:
+    # HELP text escapes only backslash and newline (label values also
+    # escape the double quote) — exposition format spec
+    return v.replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _prom_labels(labels: dict, extra: Optional[dict] = None) -> str:
     items = dict(labels)
     if extra:
@@ -54,7 +60,7 @@ def prometheus_text(registry=None) -> str:
     for name, kind, help, labels, m in reg.collect():
         if name != last_name:
             if help:
-                lines.append(f"# HELP {name} {help}")
+                lines.append(f"# HELP {name} {_prom_help_escape(help)}")
             lines.append(f"# TYPE {name} "
                          f"{'summary' if kind == 'histogram' else kind}")
             last_name = name

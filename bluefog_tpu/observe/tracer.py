@@ -62,6 +62,10 @@ class Tracer:
         self._n_emitted = 0
         self._sinks: List[object] = []
         self._open_spans: Dict[str, List[str]] = {}
+        # per-thread (track, name) stack: which span THIS thread is
+        # inside right now — the correlation source structured logs
+        # join the trace on (active_span)
+        self._tls = threading.local()
 
     # -- sinks --------------------------------------------------------- #
     def add_sink(self, sink) -> None:
@@ -100,6 +104,10 @@ class Tracer:
         with self._lock:
             self._open_spans.setdefault(track, []).append(name)
             self._emit_locked("B", name, track)
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append((track, name))
 
     def end(self, track: str) -> None:
         """Close the innermost open span on ``track`` (a no-op end on a
@@ -116,6 +124,40 @@ class Tracer:
                 # for the life of the default-on global tracer
                 self._open_spans.pop(track, None)
             self._emit_locked("E", "", track)
+        stack = getattr(self._tls, "stack", None)
+        if stack:
+            # remove the INNERMOST entry for that track — producers
+            # like the eager op API end spans non-LIFO (begin A,
+            # begin B, end A, end B: concurrent in-flight handles), and
+            # a top-only pop would leak A's entry in the thread-local
+            # stack forever.  A track this thread never began (foreign
+            # B/E through the flat timeline API) removes nothing.
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][0] == track:
+                    del stack[i]
+                    break
+
+    def _prune_stale_locked(self, stack) -> None:
+        """Drop trailing thread-local entries whose track has NO open
+        span globally: a span begun on this thread may be ENDED by
+        another (the nonblocking handle API dispatches on one thread
+        and synchronizes on another), which closes ``_open_spans`` but
+        cannot touch the beginner's TLS stack.  Pruned lazily here so
+        the stack neither grows unboundedly nor mis-stamps log lines
+        with long-closed spans.  Caller holds ``self._lock``."""
+        while stack and stack[-1][0] not in self._open_spans:
+            stack.pop()
+
+    def active_span(self) -> Optional[tuple]:
+        """The ``(track, name)`` of the innermost span the CALLING
+        thread is inside, or ``None`` — what ``BLUEFOG_LOG_FORMAT=json``
+        stamps on log lines so structured logs join the Chrome trace."""
+        stack = getattr(self._tls, "stack", None)
+        if not stack:
+            return None
+        with self._lock:
+            self._prune_stale_locked(stack)
+        return stack[-1] if stack else None
 
     def instant(self, name: str, track: str = "") -> None:
         """A zero-duration marker event."""

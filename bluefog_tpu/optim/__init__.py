@@ -19,6 +19,8 @@ from bluefog_tpu.optim.wrappers import (  # noqa: F401
 )
 from bluefog_tpu.optim.functional import (  # noqa: F401
     GuardConfig,
+    HealthConfig,
+    HealthVector,
     build_train_step,
     comm_weight_inputs,
     consensus_distance,

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Any, Callable, Optional, Sequence, Union
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -49,6 +49,8 @@ CommSpec = Union[Topology, DynamicTopology]
 
 __all__ = [
     "GuardConfig",
+    "HealthConfig",
+    "HealthVector",
     "build_train_step",
     "comm_weight_inputs",
     "push_sum_weights",
@@ -88,6 +90,83 @@ class GuardConfig:
     max_rollbacks: int = 8
 
 
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """In-graph training-health instrumentation policy for
+    :func:`build_train_step`.
+
+    Only the PRESENCE of a HealthConfig changes the compiled program:
+    the step additionally emits a :class:`HealthVector` — a small,
+    FIXED-SHAPE bundle of per-rank health scalars computed from tensors
+    the step already materializes.  It is shape-stable across every
+    fault pattern (faults are traced inputs, same discipline as
+    :class:`GuardConfig` — zero recompiles, asserted via jit cache
+    sizes in tests/test_fleet.py), and with ``health=None`` (the
+    default) the built step is bit-identical to one built without the
+    feature.
+
+    * ``consensus`` — include the consensus distance
+      ``‖x_i − Σ_j w_ij x_j‖`` (the rank's pre-combine state vs the
+      neighbor combine's output, which the exchange materializes
+      anyway).  ``False`` reports 0.0 there and skips the reduction.
+    """
+
+    consensus: bool = True
+
+
+class HealthVector(NamedTuple):
+    """Per-rank in-graph health scalars a train step emits under
+    ``health=HealthConfig(...)`` — rank-major ``[n]`` float32 vectors
+    (inside ``shard_map`` each field is the rank's scalar):
+
+    * ``loss`` — the rank's step loss (duplicated from the step output
+      so the vector is self-contained for gossip);
+    * ``grad_norm`` — global L2 norm of the rank's LOCAL gradients
+      (before any cross-rank reduction; model-parallel leaves
+      contribute their shard);
+    * ``update_norm`` — global L2 norm of the optax update;
+    * ``skipped`` — the guard's skip flag under ``guard=``; without a
+      guard, the same in-graph isfinite reduce as a *would-skip* bit
+      (reported, not acted on);
+    * ``consensus`` — ``‖x_i − Σ_j w_ij x_j‖`` over the rank's local
+      parameter shard (0.0 when no neighbor combine ran this step:
+      off-cycle steps under ``num_steps_per_communication``, or comm
+      modes without a neighbor exchange).
+
+    Being a NamedTuple it is a pytree: feed it straight to host-side
+    consumers (``bluefog_tpu.observe.fleet``) or stack fields for
+    gossip.
+    """
+
+    loss: Any
+    grad_norm: Any
+    update_norm: Any
+    skipped: Any
+    consensus: Any
+
+
+def _tree_sq_sum(tree) -> jax.Array:
+    """f32 sum of squares over every inexact leaf (0.0 for none)."""
+    acc = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree.leaves(tree):
+        leaf = jnp.asarray(leaf)
+        if jnp.issubdtype(leaf.dtype, jnp.inexact):
+            acc = acc + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return acc
+
+
+def _tree_distance(a, b) -> jax.Array:
+    """f32 L2 distance between two structurally-identical trees
+    (inexact leaves only) — the in-graph consensus-distance kernel."""
+    acc = jnp.zeros((), jnp.float32)
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        la = jnp.asarray(la)
+        if jnp.issubdtype(la.dtype, jnp.inexact):
+            d = la.astype(jnp.float32) - jnp.asarray(lb).astype(jnp.float32)
+            acc = acc + jnp.sum(jnp.square(d))
+    return jnp.sqrt(acc)
+
+
 def comm_weight_inputs(specs: Sequence[CommSpec]) -> tuple:
     """The combine weights of a topology/schedule as TRACED-OPERAND data:
     one ``(class_weights [n_classes, n], self_weights [n])`` pair per
@@ -109,6 +188,23 @@ def _all_finite(loss: jax.Array, updates: Any) -> jax.Array:
         if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
             ok = ok & jnp.all(jnp.isfinite(leaf))
     return ok
+
+
+def _make_health_vector(loss, grad_sq, updates, consensus,
+                        skipped=None) -> "HealthVector":
+    """The per-rank HealthVector (traced scalars), shared by the
+    guarded and unguarded builders so the field definitions cannot
+    drift — ``skipped`` defaults to the same in-graph isfinite reduce
+    the guard uses, reported as a would-skip bit."""
+    if skipped is None:
+        ok = _all_finite(loss, updates)
+        skipped = jnp.where(ok, jnp.float32(0), jnp.float32(1))
+    return HealthVector(
+        loss=jnp.asarray(loss, jnp.float32),
+        grad_norm=jnp.sqrt(grad_sq),
+        update_norm=jnp.sqrt(_tree_sq_sum(updates)),
+        skipped=jnp.asarray(skipped, jnp.float32),
+        consensus=jnp.asarray(consensus, jnp.float32))
 
 
 def _weighted_combine_fn(spec: CommSpec, axis_name: str,
@@ -422,7 +518,8 @@ def _combine_fn(spec: CommSpec, axis_name: str,
                                        compress=compress), tree)
 
 
-def _observed_step(step_fn: Callable, labels: dict) -> Callable:
+def _observed_step(step_fn: Callable, labels: dict,
+                   edge_traffic: Optional[tuple] = None) -> Callable:
     """Host-side observability wrapper for a built train step: each
     dispatch increments ``bf_train_steps_total{comm_mode,overlap,
     guarded}`` and runs inside a ``train_step`` span on the ``train``
@@ -430,7 +527,44 @@ def _observed_step(step_fn: Callable, labels: dict) -> Callable:
     calls the same jitted executable, so jit cache sizes and step
     outputs are bit-identical with ``BLUEFOG_OBSERVE`` on or off
     (asserted in tests/test_observe.py).  The span measures host
-    dispatch (jax is async); sync before reading it as a step time."""
+    dispatch (jax is async); sync before reading it as a step time.
+
+    ``edge_traffic`` — ``(specs, step_argpos, k_comm, n_ranks,
+    filtered)`` for the neighbor modes: per on-cycle dispatch, the
+    round's edges each get the per-rank parameter payload added to
+    ``bf_edge_bytes_total{src,dst}`` through
+    ``observe.fleet.record_edge_traffic`` (logical bytes — wire
+    compression is not folded in), the fleet-telemetry traffic account
+    derived from the topology's shift classes.  ``filtered`` selects
+    the weight-filtered push-sum edge set (``push_sum_mix`` only
+    ppermutes nonzero-weight edges) instead of the declared one
+    (``neighbor_allreduce`` moves bytes on every declared edge — its
+    weights are traced operands)."""
+    payload_cache: list = []
+    pairs_cache: dict = {}
+
+    def record_edges(args) -> None:
+        specs, step_argpos, k_comm, n_ranks, filtered = edge_traffic
+        try:
+            step_i = int(args[step_argpos])
+        except (TypeError, ValueError, IndexError):
+            return
+        if step_i % k_comm != 0:
+            return
+        if not payload_cache:
+            payload_cache.append(sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree.leaves(args[0])) // max(n_ranks, 1))
+        from bluefog_tpu.observe import fleet as _fleet
+
+        si = step_i % len(specs)
+        pairs = pairs_cache.get(si)
+        if pairs is None:
+            pairs = pairs_cache[si] = (
+                _fleet.gossip_edge_list(specs[si]) if filtered
+                else _fleet.edge_list(specs[si]))
+        _fleet.record_edge_traffic(specs[si], payload_cache[0],
+                                   pairs=pairs)
 
     def step(*args, **kwargs):
         from bluefog_tpu import observe
@@ -441,6 +575,8 @@ def _observed_step(step_fn: Callable, labels: dict) -> Callable:
         observe.get_registry().counter(
             "bf_train_steps_total", "train-step dispatches",
             **labels).inc()
+        if edge_traffic is not None:
+            record_edges(args)
         with tr.span("train", "train_step"):
             return step_fn(*args, **kwargs)
 
@@ -469,6 +605,7 @@ def build_train_step(
     overlap: str = "none",
     overlap_buckets: int = 4,
     guard: Optional[GuardConfig] = None,
+    health: Optional[HealthConfig] = None,
 ) -> Callable:
     """Compile one decentralized SGD/optax step over ``mesh``.
 
@@ -551,13 +688,25 @@ def build_train_step(
     with ``comm_mode='push_sum'`` (the (x, w) pair must mix as a unit)
     or ``hierarchical_local_size`` (weights there are machine-level).
 
+    ``health=HealthConfig(...)`` additionally emits a rank-major
+    :class:`HealthVector` as the step's LAST output — loss, local grad
+    norm, update norm, skip flag, and the consensus distance
+    ``‖x_i − Σ_j w_ij x_j‖`` computed from tensors the neighbor
+    exchange already materializes (both the plain and
+    ``overlap="bucketed"`` paths).  The vector is fixed-shape — faults
+    are inputs, nothing recompiles across fault patterns (same
+    discipline as ``guard=``) — and ``health=None`` (default) leaves
+    the step bit-identical to a pre-feature build.  Composes with
+    ``guard=`` (``skipped`` then carries the guard's actual flags).
+
     Returns ``train_step(params, opt_state, batch, step) ->
     (params, opt_state, loss)`` — all rank-major, jit-compiled with
     params/opt_state donated.  Under ``guard=`` the signature is
     ``train_step(params, opt_state, batch, step, comm_weights) ->
     (params, opt_state, loss, skipped)`` with ``skipped`` a rank-major
     ``[n]`` int32 vector of this step's skip flags (``comm_weights`` is
-    ``()`` for comm modes without neighbor weights).
+    ``()`` for comm modes without neighbor weights).  Under ``health=``
+    every variant appends the ``HealthVector`` of ``[n]`` f32 fields.
     """
     if comm_mode not in ("cta", "atc", "gradient_allreduce", "push_sum",
                          "none"):
@@ -620,7 +769,8 @@ def build_train_step(
             sp_axis=sp_axis, pp_axis=pp_axis, batch_specs=batch_specs,
             param_specs=param_specs, opt_state_specs=opt_state_specs,
             donate=donate, has_aux=has_aux, compress=compress,
-            n_buckets=overlap_buckets if bucketed else None)
+            n_buckets=overlap_buckets if bucketed else None,
+            health=health)
     if bucketed and comm_mode == "cta":
         branches = [
             _bucketed_combine_fn(s, axis_name, hierarchical_local_size,
@@ -748,25 +898,48 @@ def build_train_step(
                 return g if pp_axis in names else lax.psum(g, pp_axis)
 
             grads = jax.tree.map(_pp_reduce, grads, param_specs)
+        # local (pre-allreduce) gradient norm: the per-rank attribution
+        # signal the fleet layer gossips
+        grad_sq = _tree_sq_sum(grads) if health is not None else None
+        consensus = jnp.zeros((), jnp.float32)
         if comm_mode == "gradient_allreduce":
             grads = jax.tree.map(
                 lambda g: C.allreduce(g, axis_name, average=True), grads)
         if comm_mode == "push_sum":
             base_state, ps = opt_state
+            pre = params
             params, ps = combine_push_sum(params, ps, step)
+            if health is not None and health.consensus:
+                consensus = _tree_distance(pre, params)
             updates, base_state = optimizer.update(grads, base_state, params)
             params = optax.apply_updates(params, updates)
-            return params, new_aux, (base_state, ps), loss
+            hv = (_make_health_vector(loss, grad_sq, updates, consensus)
+                  if health is not None else None)
+            return params, new_aux, (base_state, ps), loss, hv
         if comm_mode == "cta":
+            pre = params
             params = combine(params, step)
+            if health is not None and health.consensus:
+                consensus = _tree_distance(pre, params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         if atc_bucketed:
-            params = apply_then_combine(params, updates, step)
+            new_params = apply_then_combine(params, updates, step)
+            if health is not None and health.consensus:
+                # the per-bucket applies inside apply_then_combine are
+                # the same pure arithmetic — XLA CSEs the duplicate
+                applied = optax.apply_updates(params, updates)
+                consensus = _tree_distance(applied, new_params)
+            params = new_params
         else:
             params = optax.apply_updates(params, updates)
             if comm_mode == "atc":
+                pre = params
                 params = combine(params, step)
-        return params, new_aux, opt_state, loss
+                if health is not None and health.consensus:
+                    consensus = _tree_distance(pre, params)
+        hv = (_make_health_vector(loss, grad_sq, updates, consensus)
+              if health is not None else None)
+        return params, new_aux, opt_state, loss, hv
 
     squeeze = lambda t: jax.tree.map(lambda x: x[0], t)
     expand = lambda t: jax.tree.map(lambda x: x[None], t)
@@ -776,11 +949,15 @@ def build_train_step(
 
     def wrapped(params, aux, opt_state, batch, step):
         # strip the leading per-shard rank axis of size 1
-        params, aux, opt_state, loss = per_rank_step(
+        params, aux, opt_state, loss, hv = per_rank_step(
             squeeze(params), squeeze(aux), squeeze(opt_state),
             squeeze(batch), step)
-        return (expand(params), expand(aux), expand(opt_state),
+        outs = (expand(params), expand(aux), expand(opt_state),
                 jnp.reshape(loss, (1,)))
+        if health is not None:
+            outs = outs + (HealthVector(
+                *[jnp.reshape(x, (1,)) for x in hv]),)
+        return outs
 
     p_rank = P(axis_name)
     if batch_specs is None:
@@ -790,32 +967,50 @@ def build_train_step(
     # grads/updates follow params automatically under shard_map.
     p_params = param_specs if param_specs is not None else p_rank
     p_opt = opt_state_specs if opt_state_specs is not None else p_rank
+    out_specs = (p_params, p_rank, p_opt, p_rank)
+    if health is not None:
+        out_specs = out_specs + (p_rank,)  # spec prefix over HealthVector
     sm = jax.shard_map(
         wrapped,
         mesh=mesh,
         in_specs=(p_params, p_rank, p_opt, batch_specs, P()),
-        out_specs=(p_params, p_rank, p_opt, p_rank),
+        out_specs=out_specs,
         check_vma=False,
     )
     donate_argnums = (0, 1, 2) if donate else ()
     jitted = jax.jit(sm, donate_argnums=donate_argnums)
+    # traffic accounting only for modes that actually run a neighbor
+    # exchange — a topology passed alongside comm_mode='none' /
+    # 'gradient_allreduce' must not count phantom edge bytes
+    edge_traffic = (list(specs), 4 if has_aux else 3, k_comm,
+                    int(mesh.shape[axis_name]),
+                    comm_mode == "push_sum") \
+        if (specs and needs_topo) else None
     if has_aux:
-        aux_step = _observed_step(jitted, obs_labels)
+        aux_step = _observed_step(jitted, obs_labels, edge_traffic)
         aux_step.jitted = jitted
         aux_step.lower = jitted.lower
+        aux_step.health_config = health
         return aux_step
 
-    def no_aux_step(params, opt_state, batch, step):
-        params, _, opt_state, loss = jitted(
-            params, (), opt_state, batch, step)
-        return params, opt_state, loss
+    if health is None:
+        def no_aux_step(params, opt_state, batch, step):
+            params, _, opt_state, loss = jitted(
+                params, (), opt_state, batch, step)
+            return params, opt_state, loss
+    else:
+        def no_aux_step(params, opt_state, batch, step):
+            params, _, opt_state, loss, hv = jitted(
+                params, (), opt_state, batch, step)
+            return params, opt_state, loss, hv
 
-    step_fn = _observed_step(no_aux_step, obs_labels)
+    step_fn = _observed_step(no_aux_step, obs_labels, edge_traffic)
     # AOT access for benchmarks: lower/compile the real program (e.g. for
     # XLA cost analysis / MFU accounting) without re-jitting the wrapper.
     step_fn.jitted = jitted
     step_fn.lower = lambda params, opt_state, batch, step: jitted.lower(
         params, (), opt_state, batch, step)
+    step_fn.health_config = health
     return step_fn
 
 
@@ -838,6 +1033,7 @@ def _build_guarded_train_step(
     has_aux: bool,
     compress: Optional[str],
     n_buckets: Optional[int],
+    health: Optional[HealthConfig] = None,
 ) -> Callable:
     """The ``guard=`` variant of :func:`build_train_step` (see its
     docstring for the contract).  Kept separate so the unguarded fast
@@ -894,6 +1090,8 @@ def _build_guarded_train_step(
                 return g if pp_axis in names else lax.psum(g, pp_axis)
 
             grads = jax.tree.map(_pp_reduce, grads, param_specs)
+        grad_sq = _tree_sq_sum(grads) if health is not None else None
+        consensus = jnp.zeros((), jnp.float32)
         if comm_mode == "gradient_allreduce":
             # NOTE: the allreduce mixes GRADIENTS, so one rank's NaN
             # reaches every rank's update — the guard then skips
@@ -902,7 +1100,10 @@ def _build_guarded_train_step(
             grads = jax.tree.map(
                 lambda g: C.allreduce(g, axis_name, average=True), grads)
         if comm_mode == "cta":
+            pre = params
             params = combine(params, step, comm_weights)
+            if health is not None and health.consensus:
+                consensus = _tree_distance(pre, params)
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
         ok = _all_finite(loss, updates)
 
@@ -926,19 +1127,29 @@ def _build_guarded_train_step(
         out_aux = jax.tree.map(pick, new_aux, aux)
         out_opt = jax.tree.map(pick, new_opt_state, opt_state)
         if comm_mode == "atc":
+            pre = params
             params = combine(params, step, comm_weights)
+            if health is not None and health.consensus:
+                consensus = _tree_distance(pre, params)
         skipped = jnp.where(ok, jnp.int32(0), jnp.int32(1))
-        return params, out_aux, out_opt, loss, skipped
+        hv = (_make_health_vector(loss, grad_sq, updates, consensus,
+                                  skipped=skipped)
+              if health is not None else None)
+        return params, out_aux, out_opt, loss, skipped, hv
 
     squeeze = lambda t: jax.tree.map(lambda x: x[0], t)
     expand = lambda t: jax.tree.map(lambda x: x[None], t)
 
     def wrapped(params, aux, opt_state, batch, step, comm_weights):
-        params, aux, opt_state, loss, skipped = per_rank_step(
+        params, aux, opt_state, loss, skipped, hv = per_rank_step(
             squeeze(params), squeeze(aux), squeeze(opt_state),
             squeeze(batch), step, comm_weights)
-        return (expand(params), expand(aux), expand(opt_state),
+        outs = (expand(params), expand(aux), expand(opt_state),
                 jnp.reshape(loss, (1,)), jnp.reshape(skipped, (1,)))
+        if health is not None:
+            outs = outs + (HealthVector(
+                *[jnp.reshape(x, (1,)) for x in hv]),)
+        return outs
 
     p_rank = P(axis_name)
     if batch_specs is None:
@@ -947,11 +1158,14 @@ def _build_guarded_train_step(
     p_opt = opt_state_specs if opt_state_specs is not None else p_rank
     # comm weights ride replicated (every rank reads the full tables)
     p_comm = tuple((P(), P()) for _ in wbranches)
+    out_specs = (p_params, p_rank, p_opt, p_rank, p_rank)
+    if health is not None:
+        out_specs = out_specs + (p_rank,)  # spec prefix over HealthVector
     sm = jax.shard_map(
         wrapped,
         mesh=mesh,
         in_specs=(p_params, p_rank, p_opt, batch_specs, P(), p_comm),
-        out_specs=(p_params, p_rank, p_opt, p_rank, p_rank),
+        out_specs=out_specs,
         check_vma=False,
     )
     donate_argnums = (0, 1, 2) if donate else ()
@@ -963,24 +1177,36 @@ def _build_guarded_train_step(
         overlap="bucketed" if n_buckets is not None else "none",
         guarded="true")
 
+    # guarded steps are cta/atc only — neighbor_allreduce moves bytes
+    # on every declared edge, so the unfiltered edge set is correct
+    edge_traffic = (list(specs), 4 if has_aux else 3, k_comm,
+                    int(mesh.shape[axis_name]), False) \
+        if wbranches else None
     if has_aux:
         def aux_step(params, aux, opt_state, batch, step, comm_weights):
             return jitted(params, aux, opt_state, batch, step,
                           comm_weights)
 
-        step_fn = _observed_step(aux_step, obs_labels)
+        step_fn = _observed_step(aux_step, obs_labels, edge_traffic)
         step_fn.jitted = jitted
         step_fn.default_comm_weights = default_w
         step_fn.has_aux = True  # run_resilient rejects aux signatures
         step_fn.guard_config = guard
+        step_fn.health_config = health
         return step_fn
 
-    def no_aux_step(params, opt_state, batch, step, comm_weights):
-        params, _, opt_state, loss, skipped = jitted(
-            params, (), opt_state, batch, step, comm_weights)
-        return params, opt_state, loss, skipped
+    if health is None:
+        def no_aux_step(params, opt_state, batch, step, comm_weights):
+            params, _, opt_state, loss, skipped = jitted(
+                params, (), opt_state, batch, step, comm_weights)
+            return params, opt_state, loss, skipped
+    else:
+        def no_aux_step(params, opt_state, batch, step, comm_weights):
+            params, _, opt_state, loss, skipped, hv = jitted(
+                params, (), opt_state, batch, step, comm_weights)
+            return params, opt_state, loss, skipped, hv
 
-    step_fn = _observed_step(no_aux_step, obs_labels)
+    step_fn = _observed_step(no_aux_step, obs_labels, edge_traffic)
     step_fn.jitted = jitted
     step_fn.lower = (
         lambda params, opt_state, batch, step, comm_weights:
@@ -988,4 +1214,5 @@ def _build_guarded_train_step(
     step_fn.default_comm_weights = default_w
     step_fn.has_aux = False
     step_fn.guard_config = guard
+    step_fn.health_config = health
     return step_fn

@@ -54,6 +54,7 @@ __all__ = [
     "neighbor_allgather_padded",
     "in_neighbor_lists",
     "pair_gossip",
+    "push_sum_structure",
     "push_sum_mix",
     "hierarchical_neighbor_allreduce",
     "machine_groups",
@@ -513,10 +514,14 @@ def pair_gossip(
     return out.astype(x.dtype)
 
 
-def _push_sum_structure(spec: CommSpec):
+def push_sum_structure(spec: CommSpec):
     """(out_degrees, filtered perms): only edges with nonzero combine
     weight count as push-sum out-edges (a 0.0-weight edge in a
-    DynamicTopology is declared but carries nothing)."""
+    DynamicTopology is declared but carries nothing).  Shared by the
+    on-device mix (:func:`push_sum_mix`) and the host-side fleet
+    gossip (``bluefog_tpu.observe.fleet``), so both walk the SAME
+    column-stochastic structure — a healed spec (zeroed dead edges)
+    excises the dead rank from either path identically."""
     deg = np.zeros(spec.size, dtype=np.int64)
     perms = []
     for cls in spec.shift_classes:
@@ -555,7 +560,7 @@ def push_sum_mix(tree, ps_weight: jax.Array, spec: CommSpec,
     Returns ``(mixed_tree, mixed_ps)`` — still biased; de-bias with
     ``z = x / ps`` (reference optimizers.py:1151-1155).
     """
-    deg, perms = _push_sum_structure(spec)
+    deg, perms = push_sum_structure(spec)
     idx = lax.axis_index(axis_name)
     a = jnp.asarray(1.0 / (deg + 1.0), jnp.float32)[idx]
 

@@ -24,7 +24,7 @@ program.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -71,6 +71,11 @@ class FailureDetector:
         self._consecutive = np.zeros(size, np.int64)
         self._total = np.zeros(size, np.int64)
         self._dead = np.zeros(size, bool)
+        # rank -> the set of SOURCES currently suspecting it: several
+        # independent monitors (straggler gossip, heartbeats, an
+        # operator) may suspect the same rank, and one source clearing
+        # its claim must not erase the others'
+        self._external: Dict[int, set] = {}
 
     # ------------------------------------------------------------- #
     # numeric health
@@ -91,10 +96,61 @@ class FailureDetector:
     def total_skips(self) -> np.ndarray:
         return self._total.copy()
 
-    def suspects(self, k: int) -> List[int]:
-        """Live ranks with >= k consecutive skipped steps."""
+    def streak_suspects(self, k: int) -> List[int]:
+        """Live ranks with >= k consecutive skipped steps — the purely
+        NUMERIC evidence.  This is what the rollback loop's death
+        declaration keys on: a straggler flag (external suspicion) must
+        never convert a NaN window into an execution of a
+        healthy-but-slow rank."""
         return [int(r) for r in
                 np.nonzero((self._consecutive >= k) & ~self._dead)[0]]
+
+    def suspects(self, k: int) -> List[int]:
+        """The fused suspicion view: live ranks with >= k consecutive
+        skipped steps, plus any EXTERNALLY suspected live ranks
+        (``suspect`` — the fleet telemetry layer's straggler flags land
+        here).  For monitoring/policy; death attribution uses
+        :meth:`streak_suspects`."""
+        out = set(self.streak_suspects(k))
+        out |= {r for r, srcs in self._external.items()
+                if srcs and not self._dead[r]}
+        return sorted(out)
+
+    def suspect(self, ranks: Sequence[int],
+                source: str = "external") -> None:
+        """Register external suspicion from ``source`` (e.g.
+        ``"straggler"`` for the gossiped flags of
+        ``observe.fleet.StragglerDetector``); already-dead ranks are
+        ignored.  A rank stays suspected while ANY source claims it."""
+        for r in ranks:
+            if not 0 <= r < self.size:
+                raise ValueError(f"rank {r} outside world {self.size}")
+            if not self._dead[r]:
+                self._external.setdefault(int(r), set()).add(source)
+
+    def clear_suspicion(self, ranks: Optional[Sequence[int]] = None,
+                        source: Optional[str] = None) -> None:
+        """Withdraw external suspicion: ``source``'s claims only (every
+        source's with ``source=None``), on ``ranks`` (all ranks with
+        ``ranks=None``).  A rank another source still suspects stays
+        suspected — one monitor's recovery never erases another's
+        standing claim."""
+        targets = (list(self._external) if ranks is None
+                   else [int(r) for r in ranks])
+        for r in targets:
+            srcs = self._external.get(r)
+            if srcs is None:
+                continue
+            if source is None:
+                srcs.clear()
+            else:
+                srcs.discard(source)
+            if not srcs:
+                self._external.pop(r, None)
+
+    def external_suspects(self) -> List[int]:
+        return sorted(r for r, srcs in self._external.items()
+                      if srcs and not self._dead[r])
 
     def declare_dead(self, ranks: Sequence[int]) -> None:
         for r in ranks:

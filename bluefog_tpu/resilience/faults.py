@@ -35,10 +35,11 @@ class Fault:
     """One scheduled fault.
 
     ``step``: first step the fault is active.  ``duration``: steps a
-    nan/inf burst lasts (ignored for ``dead``, which is permanent, and
-    for ``stall``, which fires once).  ``stall_seconds``: host-loop
-    sleep injected by a ``stall`` fault (exercises the watchdog / op
-    timeout, not the numerics)."""
+    nan/inf burst — or a ``stall`` — lasts (ignored for ``dead``,
+    which is permanent).  ``stall_seconds``: host-loop sleep injected
+    PER ACTIVE STEP by a ``stall`` fault (exercises the watchdog / op
+    timeout / straggler detector, not the numerics); a multi-step
+    stall on one rank is the injected-straggler scenario."""
 
     step: int
     rank: int
@@ -96,6 +97,16 @@ class FaultPlan:
     def rank_death(size: int, rank: int, step: int) -> "FaultPlan":
         return FaultPlan(size, [Fault(step, rank, DEAD)])
 
+    @staticmethod
+    def straggler(size: int, rank: int, step: int, duration: int,
+                  stall_seconds: float) -> "FaultPlan":
+        """One rank runs ``stall_seconds`` slow for ``duration``
+        consecutive steps — the injected-straggler scenario the
+        ``observe.fleet.StragglerDetector`` must name (chaos bench:
+        detection latency is a machine-checked claim)."""
+        return FaultPlan(size, [Fault(step, rank, STALL, duration,
+                                      stall_seconds=stall_seconds)])
+
     def merged(self, other: "FaultPlan") -> "FaultPlan":
         if other.size != self.size:
             raise ValueError("cannot merge plans over different sizes")
@@ -110,8 +121,6 @@ class FaultPlan:
         for f in self.faults:
             if f.kind == DEAD:
                 live = step >= f.step
-            elif f.kind == STALL:
-                live = step == f.step
             else:
                 live = f.step <= step < f.step + f.duration
             if live:
@@ -136,6 +145,16 @@ class FaultPlan:
     def stall_seconds(self, step: int) -> float:
         return float(sum(f.stall_seconds for f in self.active(step)
                          if f.kind == STALL))
+
+    def stall_seconds_by_rank(self, step: int) -> np.ndarray:
+        """Per-rank injected stall at ``step`` — the ``[n]`` vector a
+        per-rank step-time synthesizer adds on top of the measured
+        wall time (``run_resilient(step_times_fn=...)``)."""
+        out = np.zeros(self.size, np.float64)
+        for f in self.active(step):
+            if f.kind == STALL:
+                out[f.rank] += f.stall_seconds
+        return out
 
     def last_onset(self) -> int:
         """The latest fault onset step (0 for an empty plan) — a chaos

@@ -46,9 +46,11 @@ __all__ = ["ResilienceEvent", "ResilientResult", "run_resilient"]
 @dataclasses.dataclass(frozen=True)
 class ResilienceEvent:
     """One entry of the run's event log: ``kind`` in {"checkpoint",
-    "skip", "rank_dead", "rollback"}; ``step`` is the step index the
-    event fired at; ``detail`` carries kind-specific fields (rollback:
-    ``restored_step``, ``backoff``, ``dead``)."""
+    "skip", "rank_dead", "rollback", "straggler",
+    "bad_window_unattributed"}; ``step`` is the step index the event
+    fired at; ``detail`` carries kind-specific fields (rollback:
+    ``restored_step``, ``backoff``, ``dead``; straggler: ``ranks``,
+    ``z``)."""
 
     kind: str
     step: int
@@ -85,6 +87,8 @@ def run_resilient(
     checkpoint_every: int = 10,
     sleep: Callable[[float], None] = time.sleep,
     on_event: Optional[Callable[[ResilienceEvent], None]] = None,
+    straggler=None,
+    step_times_fn: Optional[Callable[[int, float], Any]] = None,
 ) -> ResilientResult:
     """Train ``steps`` steps under faults; see the module docstring for
     the recovery semantics.
@@ -99,6 +103,21 @@ def run_resilient(
     surface); checkpoint steps store ``{"params", "opt_state", "step"}``.
     ``sleep`` is injectable so tests (and the chaos bench) run backoff
     under a virtual clock.
+
+    ``straggler`` (an ``observe.fleet.StragglerDetector``) turns the
+    loop's per-step wall time into a fleet health signal: each step the
+    detector observes the per-rank step-time vector, newly-flagged
+    ranks are emitted as ``straggler`` events and registered with
+    ``FailureDetector.suspect`` (so a slow rank is *named* before the
+    blunt ``BLUEFOG_OP_TIMEOUT`` fires), and the suspicion set tracks
+    the detector's flags (a recovered rank is un-suspected).
+    ``step_times_fn(step, wall_s) -> [n]`` supplies the per-rank
+    vector; the default broadcasts the measured local wall time to all
+    ranks (what each process would gossip in a real fleet — the chaos
+    bench injects per-rank stalls here instead).  Per-step wall time
+    also lands in the ``bf_step_wall_seconds{loop="train"}`` histogram,
+    the local metric ``observe.fleet.collect_local`` picks up for
+    gossip.
     """
     if not hasattr(train_step, "default_comm_weights"):
         raise ValueError(
@@ -146,6 +165,7 @@ def run_resilient(
         emit("checkpoint", step)
 
     like = {"params": params, "opt_state": opt_state, "step": 0}
+    prev_flagged: set = set()
     total_skips = np.zeros(n, np.int64)
     last_loss: Optional[np.ndarray] = None
     consecutive_bad = 0
@@ -161,8 +181,12 @@ def run_resilient(
                 sleep(stall)  # straggler injection: the stall watchdog /
                 # BLUEFOG_OP_TIMEOUT layer owns this failure class
             batch = fault_plan.corrupt_batch(batch, step)
-        params, opt_state, loss, skipped = train_step(
+        t_step = time.monotonic()
+        out = train_step(
             params, opt_state, batch, jnp.int32(step), comm_weights)
+        # a health-built step appends the HealthVector; the loop keys
+        # on the guard outputs either way
+        params, opt_state, loss, skipped = out[:4]
         sk = np.asarray(skipped).reshape(-1) != 0
         detector.observe(sk)
         total_skips += sk
@@ -172,7 +196,32 @@ def run_resilient(
                 reg.counter("bf_resilience_skips_total",
                             "guarded-step skips (replays included)",
                             rank=int(r)).inc()
-        last_loss = np.asarray(loss)
+        last_loss = np.asarray(loss)  # sync point: the step is done
+        wall = time.monotonic() - t_step
+        if observe.enabled():
+            observe.get_registry().histogram(
+                "bf_step_wall_seconds", "train/engine step wall time",
+                loop="train").observe(wall)
+        if straggler is not None:
+            times = (np.asarray(step_times_fn(step, wall), np.float64)
+                     if step_times_fn is not None
+                     else np.full(n, wall))
+            newly = straggler.observe(times)
+            # suspicion tracks the detector's CURRENT flags — a
+            # recovered rank is withdrawn, but only OUR flags are
+            # touched: suspicion other sources registered (heartbeats,
+            # the operator) is not ours to clear
+            flagged_now = set(straggler.flagged())
+            withdrawn = prev_flagged - flagged_now
+            if withdrawn:
+                detector.clear_suspicion(sorted(withdrawn),
+                                         source="straggler")
+            detector.suspect(sorted(flagged_now), source="straggler")
+            prev_flagged = flagged_now
+            if newly:
+                z = straggler.z_scores()
+                emit("straggler", step, ranks=[int(r) for r in newly],
+                     z=[float(z[r]) for r in newly])
         live_bad = detector.live_bad(sk)
         if live_bad:
             # only LIVE-rank skips are events: a declared-dead rank
@@ -193,7 +242,11 @@ def run_resilient(
             # transients (batch_fn and the fault environment are
             # functions of the step index) in a futile loop.  Note the
             # window and keep training instead.
-            newly = detector.suspects(guard.max_consecutive_bad)
+            # attribution is NUMERIC only (streak_suspects): an
+            # externally-suspected straggler is slow, not poisonous —
+            # killing it here would destroy healthy capacity and leave
+            # the actual NaN source live
+            newly = detector.streak_suspects(guard.max_consecutive_bad)
             if not newly:
                 emit("bad_window_unattributed", step,
                      window=guard.max_consecutive_bad)

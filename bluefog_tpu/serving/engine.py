@@ -340,6 +340,8 @@ class ServingEngine:
         """One engine iteration: shed/cancel, admit + one prefill chunk,
         one decode step over all active slots.  Returns True while there
         is live work (queued, prefilling, or decoding)."""
+        t_step = time.perf_counter()  # real wall time (the injected
+        # clock may be virtual) — feeds the fleet step-time view
         now = self.clock()
         # 1. deadline shedding in the queue (zero device cost)
         for req in self.scheduler.expire(now):
@@ -383,7 +385,8 @@ class ServingEngine:
         if decoding:
             self._decode_step(decoding)
         self.metrics.on_step(self.pool.occupancy(),
-                             self.scheduler.queue_depth)
+                             self.scheduler.queue_depth,
+                             time.perf_counter() - t_step)
         return bool(self._running or self._admitting
                     or self.scheduler.queue_depth)
 

@@ -160,7 +160,8 @@ class ServingMetrics:
             reg.histogram("bf_serving_latency_seconds",
                           "submit -> retire").observe(now - rec.submit_t)
 
-    def on_step(self, occupancy: float, queue_depth: int):
+    def on_step(self, occupancy: float, queue_depth: int,
+                step_seconds: Optional[float] = None):
         self._occupancy.append(occupancy)
         self._queue_depth.append(queue_depth)
         reg = self._reg()
@@ -170,6 +171,14 @@ class ServingMetrics:
                       "active slots / capacity, last step").set(occupancy)
             reg.gauge("bf_serving_queue_depth",
                       "queued requests, last step").set(queue_depth)
+            if step_seconds is not None:
+                # the engine's measured step wall time, in the SAME
+                # histogram family the train loop reports into — the
+                # per-rank step-time signal the fleet gossip
+                # (observe.fleet.collect_local) aggregates
+                reg.histogram("bf_step_wall_seconds",
+                              "train/engine step wall time",
+                              loop="serving").observe(step_seconds)
 
     # -- summaries ----------------------------------------------------- #
     def ttfts(self) -> List[float]:

@@ -25,9 +25,12 @@ Two writer backends:
 * **python** — a bounded queue.Queue + thread fallback, always available.
   Like the native ring, the queue REFUSES events when the writer thread
   falls behind (an unbounded queue would trade a bounded trace gap for
-  unbounded host memory) and counts the drops; ``close()`` flushes the
-  count to the ``bf_timeline_dropped_events`` registry gauge so a
-  saturated writer is visible on the metrics side, not silently lossy.
+  unbounded host memory) and counts the drops; the count flushes to the
+  ``bf_timeline_dropped_events`` registry gauge PERIODICALLY (every
+  ``BLUEFOG_TIMELINE_FLUSH_EVERY`` writer drains, and whenever the
+  queue drains to empty with undisclosed drops) plus once at
+  ``close()`` — a long-running saturated writer is visible on the
+  metrics side mid-flight, not silently lossy until shutdown.
 
 Set ``BLUEFOG_TIMELINE_NATIVE=0`` to force the Python backend.
 """
@@ -43,13 +46,16 @@ import time
 from contextlib import contextmanager
 from typing import Optional
 
+from bluefog_tpu import config as bfconfig
 from bluefog_tpu.observe import registry as _obs_registry
 from bluefog_tpu.observe import tracer as _obs_tracer
 
 __all__ = ["Timeline", "get_timeline", "start_timeline", "stop_timeline"]
 
 # Python-backend queue bound: ~the native ring's depth.  Override with
-# BLUEFOG_TIMELINE_QUEUE_CAPACITY for stress tests.
+# BLUEFOG_TIMELINE_QUEUE_CAPACITY for stress tests.  (The drop-count
+# flush interval lives in config.timeline_flush_every:
+# BLUEFOG_TIMELINE_FLUSH_EVERY, default 1024.)
 _DEFAULT_QUEUE_CAPACITY = 65536
 
 
@@ -57,9 +63,15 @@ class _PyWriter:
     """Fallback writer: bounded queue.Queue + daemon thread (GIL stands
     in for the native ring's memory ordering; the bound stands in for
     the ring's fixed depth — a full queue drops the event and counts
-    it, same contract as the native writer)."""
+    it, same contract as the native writer).
 
-    def __init__(self, path: str, rank: int, capacity: Optional[int] = None):
+    ``on_drop_flush(count)`` is called from the WRITER thread every
+    ``BLUEFOG_TIMELINE_FLUSH_EVERY`` drained events — and on any drain
+    to empty with new drops — so a saturated queue surfaces on the
+    metrics side while the run is still going."""
+
+    def __init__(self, path: str, rank: int, capacity: Optional[int] = None,
+                 on_drop_flush=None):
         self.rank = rank
         self._t0 = time.perf_counter()
         if capacity is None:
@@ -68,6 +80,12 @@ class _PyWriter:
                 str(_DEFAULT_QUEUE_CAPACITY)))
         self._queue: "queue.Queue" = queue.Queue(maxsize=capacity)
         self._dropped = 0
+        self._on_drop_flush = on_drop_flush
+        # defensive parse (malformed env falls back, never crashes
+        # timeline creation)
+        self._flush_every = bfconfig.timeline_flush_every()
+        self._drained = 0
+        self._last_flushed = 0
         self._file = open(path, "w")
         self._file.write("[\n")
         self._first = True
@@ -78,17 +96,34 @@ class _PyWriter:
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
+    def _maybe_flush_drops(self):
+        if self._on_drop_flush is None:
+            return
+        dropped = self._dropped
+        if dropped != self._last_flushed:
+            self._last_flushed = dropped
+            try:
+                self._on_drop_flush(dropped)
+            except Exception:  # the metrics side must never kill the
+                pass           # writer thread
+
     def _writer(self):
         while not self._stop.is_set() or not self._queue.empty():
             try:
                 event = self._queue.get(timeout=0.1)
             except queue.Empty:
+                # idle: disclose any drops accumulated since the last
+                # flush (a burst followed by silence must not hide)
+                self._maybe_flush_drops()
                 continue
             if not self._first:
                 self._file.write(",\n")
             self._first = False
             self._file.write(json.dumps(event))
             self._file.flush()
+            self._drained += 1
+            if self._drained % self._flush_every == 0:
+                self._maybe_flush_drops()
 
     def _put(self, event: dict) -> None:
         try:
@@ -123,7 +158,8 @@ class _PyWriter:
             pass
 
 
-def _make_writer(path: str, rank: int, use_native: Optional[bool]):
+def _make_writer(path: str, rank: int, use_native: Optional[bool],
+                 on_drop_flush=None):
     if use_native is None:
         use_native = os.environ.get("BLUEFOG_TIMELINE_NATIVE", "1") != "0"
     if use_native:
@@ -131,6 +167,7 @@ def _make_writer(path: str, rank: int, use_native: Optional[bool]):
             from bluefog_tpu import native
 
             if native.available():
+                # the native ring flushes its drop count at close() only
                 return native.NativeTimelineWriter(path, rank), "native"
         except (ImportError, OSError, RuntimeError) as exc:
             from bluefog_tpu.logging_util import get_logger
@@ -138,7 +175,7 @@ def _make_writer(path: str, rank: int, use_native: Optional[bool]):
             get_logger().warning(
                 "native timeline writer unavailable (%s); using the Python "
                 "backend", exc)
-    return _PyWriter(path, rank), "python"
+    return _PyWriter(path, rank, on_drop_flush=on_drop_flush), "python"
 
 
 class Timeline:
@@ -155,7 +192,9 @@ class Timeline:
                  use_native: Optional[bool] = None, tracer=None):
         self.path = f"{path}{rank}.json"
         self.rank = rank
-        self._writer, self.backend = _make_writer(self.path, rank, use_native)
+        self._writer, self.backend = _make_writer(
+            self.path, rank, use_native,
+            on_drop_flush=self._flush_dropped_gauge)
         self.tracer = tracer if tracer is not None else _obs_tracer.Tracer(
             pid=rank)
         self.tracer.add_sink(self._writer)
@@ -178,6 +217,16 @@ class Timeline:
     def dropped_events(self) -> int:
         return self._writer.dropped()
 
+    def _flush_dropped_gauge(self, dropped: int) -> None:
+        """Land the drop count in the registry gauge — called
+        periodically from the Python writer thread (every
+        ``BLUEFOG_TIMELINE_FLUSH_EVERY`` drains) and once at close."""
+        if _obs_registry.enabled():
+            _obs_registry.get_registry().gauge(
+                "bf_timeline_dropped_events",
+                "events the timeline writer dropped (saturated queue/ring)",
+                rank=self.rank).set(dropped)
+
     @contextmanager
     def context(self, tensor_name: str, activity: str):
         self.start_activity(tensor_name, activity)
@@ -193,13 +242,10 @@ class Timeline:
         self.tracer.remove_sink(self._writer)
         dropped = self._writer.dropped()
         self._writer.close()
-        if _obs_registry.enabled():
-            # flush the final drop count where a dashboard can see it —
-            # a saturated writer must not be silently lossy
-            _obs_registry.get_registry().gauge(
-                "bf_timeline_dropped_events",
-                "events the timeline writer dropped (saturated queue/ring)",
-                rank=self.rank).set(dropped)
+        # flush the FINAL drop count where a dashboard can see it —
+        # mid-run flushes only fire every BLUEFOG_TIMELINE_FLUSH_EVERY
+        # drains, and the native ring only reports here
+        self._flush_dropped_gauge(dropped)
 
 
 _timeline: Optional[Timeline] = None

@@ -66,6 +66,8 @@ MODULES = [
      "HLO-attributed step profiler (profile_step / StepProfile)"),
     ("bluefog_tpu.observe.export",
      "exporters: Prometheus text, JSONL events, Chrome trace, snapshot"),
+    ("bluefog_tpu.observe.fleet",
+     "fleet telemetry: push-sum metric gossip, edge traffic, stragglers"),
     ("bluefog_tpu.parallel.collectives",
      "XLA collective data plane (mesh ops)"),
     ("bluefog_tpu.parallel.ring_attention", "ring/blockwise attention (SP)"),

@@ -139,6 +139,22 @@ def test_tracer_per_thread_tracks():
     assert "worker-7" in tracks
 
 
+def test_tracer_active_span_interleaved_tracks():
+    """active_span survives non-LIFO begin/end interleavings (the
+    eager op API ends concurrent in-flight handle spans out of order):
+    no entry may leak in the thread-local stack."""
+    tr = Tracer()
+    tr.begin("op.1", "ENQUEUE")
+    tr.begin("op.2", "ENQUEUE")
+    assert tr.active_span() == ("op.2", "ENQUEUE")
+    tr.end("op.1")  # out of order
+    assert tr.active_span() == ("op.2", "ENQUEUE")
+    tr.end("op.2")
+    assert tr.active_span() is None  # nothing leaked
+    tr.end("op.never-began")  # foreign end: no crash, no underflow
+    assert tr.active_span() is None
+
+
 def test_tracer_ring_buffer_bounds_memory():
     tr = Tracer(max_events=8)
     for i in range(20):
@@ -203,6 +219,53 @@ def test_timeline_reports_saturated_queue_drops(tmp_path, monkeypatch):
     gauge = observe.get_registry().gauge("bf_timeline_dropped_events",
                                          rank=0)
     assert gauge.value == tl.dropped_events() > 0
+
+
+def test_timeline_flushes_drop_gauge_mid_run(tmp_path, monkeypatch):
+    """ISSUE 5 satellite: the drop count must reach the registry gauge
+    PERIODICALLY (every BLUEFOG_TIMELINE_FLUSH_EVERY drains / on drain
+    to empty), not only at close() — a long-running saturated run is
+    visible before shutdown.  Saturate the bounded queue behind a
+    blocked file, release, and poll the gauge BEFORE closing."""
+    import time as _time
+
+    monkeypatch.setenv("BLUEFOG_TIMELINE_QUEUE_CAPACITY", "8")
+    monkeypatch.setenv("BLUEFOG_TIMELINE_FLUSH_EVERY", "4")
+    from bluefog_tpu.timeline import Timeline
+
+    observe.get_registry().reset()
+    tl = Timeline(str(tmp_path / "midrun"), rank=1, use_native=False)
+    try:
+        release = threading.Event()
+        real_file = tl._writer._file
+
+        class _BlockingFile:
+            def write(self, s):
+                release.wait(timeout=10.0)
+                return real_file.write(s)
+
+            def flush(self):
+                real_file.flush()
+
+            def close(self):
+                real_file.close()
+
+        tl._writer._file = _BlockingFile()
+        for i in range(64):  # queue cap 8 -> must overflow
+            tl.instant(f"burst{i}")
+        assert tl.dropped_events() > 0
+        release.set()
+        gauge = observe.get_registry().gauge("bf_timeline_dropped_events",
+                                             rank=1)
+        deadline = _time.monotonic() + 10.0
+        while gauge.value == 0.0 and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        # the run is still OPEN — the writer thread disclosed the drops
+        assert gauge.value > 0
+        assert gauge.value <= tl.dropped_events()
+    finally:
+        tl.close()
+    assert gauge.value == tl.dropped_events()
 
 
 def test_timeline_under_opt_out_stays_private(tmp_path, monkeypatch):
@@ -452,6 +515,122 @@ def test_prometheus_text_format(registry):
     assert 'bf_lat{quantile="0.5"} 2.0' in lines
 
 
+_PROM_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_PROM_LABEL = r"[a-zA-Z_][a-zA-Z0-9_]*"
+
+
+def _strict_parse_prometheus(text):
+    """A STRICT exposition-format parser (the test's own, so the
+    exporter can't grade its own homework): validates HELP/TYPE
+    grammar, HELP-before-samples ordering, one TYPE per family, label
+    escaping, and sample-line shape.  Returns {family: {"type", "help",
+    "samples": [(name, labels, value)]}}."""
+    import re
+
+    families = {}
+    current = None
+    for ln in text.splitlines():
+        assert ln == ln.rstrip(), f"trailing whitespace: {ln!r}"
+        if ln.startswith("# HELP "):
+            m = re.fullmatch(rf"# HELP ({_PROM_NAME}) (.*)", ln)
+            assert m, f"bad HELP line: {ln!r}"
+            name, help_text = m.group(1), m.group(2)
+            # escaped help: no raw newline possible (we're line-split),
+            # and any backslash must start \\ or \n
+            assert re.fullmatch(r"([^\\]|\\\\|\\n)*", help_text), \
+                f"unescaped backslash in HELP: {help_text!r}"
+            assert name not in families, f"duplicate HELP for {name}"
+            families[name] = {"type": None, "help": help_text,
+                              "samples": []}
+            current = name
+        elif ln.startswith("# TYPE "):
+            m = re.fullmatch(
+                rf"# TYPE ({_PROM_NAME}) "
+                r"(counter|gauge|summary|histogram|untyped)", ln)
+            assert m, f"bad TYPE line: {ln!r}"
+            name = m.group(1)
+            fam = families.setdefault(
+                name, {"type": None, "help": "", "samples": []})
+            assert fam["type"] is None, f"duplicate TYPE for {name}"
+            assert not fam["samples"], f"TYPE after samples for {name}"
+            fam["type"] = m.group(2)
+            current = name
+        else:
+            m = re.fullmatch(
+                rf"({_PROM_NAME})(?:\{{(.*)\}})? "
+                r"([0-9eE.+-]+|NaN|[+-]Inf)", ln)
+            assert m, f"bad sample line: {ln!r}"
+            name, labels_body, value = m.groups()
+            labels = {}
+            if labels_body:
+                # tokenize k="v" pairs honoring \\ \" \n escapes
+                pair = re.compile(
+                    rf'({_PROM_LABEL})="((?:[^"\\]|\\.)*)"(,|$)')
+                pos = 0
+                while pos < len(labels_body):
+                    pm = pair.match(labels_body, pos)
+                    assert pm, f"bad labels at {labels_body[pos:]!r}"
+                    for esc in re.finditer(r"\\(.)", pm.group(2)):
+                        assert esc.group(1) in ('\\', '"', 'n'), \
+                            f"bad escape \\{esc.group(1)}"
+                    labels[pm.group(1)] = pm.group(2)
+                    pos = pm.end()
+            base = name
+            for suffix in ("_count", "_sum", "_bucket"):
+                if name.endswith(suffix) and name[:-len(suffix)] in families:
+                    base = name[:-len(suffix)]
+            assert base in families, f"sample {name} before its TYPE"
+            float(value)
+            families[base]["samples"].append((name, labels, value))
+    for name, fam in families.items():
+        assert fam["type"] is not None, f"{name} has HELP but no TYPE"
+        assert fam["samples"], f"family {name} emitted no samples"
+    return families
+
+
+def test_prometheus_exposition_strict(registry):
+    """ISSUE 5 satellite: strict-parser test over prometheus_text() —
+    HELP/TYPE lines, label + HELP escaping, summary family naming —
+    with fleet metrics included."""
+    import numpy as np
+    from bluefog_tpu.observe import fleet as FL
+    from bluefog_tpu.topology.dynamic import one_peer_dynamic_schedule
+
+    registry.counter("bf_ops_total", "eager op dispatches",
+                     op="allreduce").inc(2)
+    registry.counter("bf_ops_total", "eager op dispatches",
+                     op="broadcast").inc()
+    # hostile label value and HELP text: escaping must round-trip
+    registry.gauge("bf_hostile", 'a "quoted"\nback\\slash help',
+                   path='we"ird\nva\\lue').set(1)
+    h = registry.histogram("bf_lat_seconds", "latency")
+    h.observe(0.5)
+    # fleet metrics land through the same registry
+    agg = FL.FleetAggregator(one_peer_dynamic_schedule(8),
+                             registry=registry)
+    agg.publish(("step_time_p50",), np.arange(8, dtype=float))
+
+    text = observe.prometheus_text(registry)
+    fams = _strict_parse_prometheus(text)
+    assert fams["bf_ops_total"]["type"] == "counter"
+    assert len(fams["bf_ops_total"]["samples"]) == 2
+    assert fams["bf_lat_seconds"]["type"] == "summary"
+    names = [s[0] for s in fams["bf_lat_seconds"]["samples"]]
+    assert names == ["bf_lat_seconds_count", "bf_lat_seconds_sum",
+                     "bf_lat_seconds", "bf_lat_seconds"]
+    quantiles = [s[1]["quantile"] for s in
+                 fams["bf_lat_seconds"]["samples"][2:]]
+    assert quantiles == ["0.5", "0.99"]
+    hostile = fams["bf_hostile"]["samples"][0][1]["path"]
+    assert hostile == r'we\"ird\nva\\lue'
+    assert fams["bf_hostile"]["help"] == \
+        'a "quoted"\\nback\\\\slash help'
+    assert fams["bf_fleet_step_time_p50"]["type"] == "gauge"
+    assert fams["bf_edge_bytes_total"]["type"] == "counter"
+    assert all(set(s[1]) == {"src", "dst"}
+               for s in fams["bf_edge_bytes_total"]["samples"])
+
+
 def test_jsonl_and_snapshot(tmp_path):
     tr = Tracer()
     with tr.span("track", "phase"):
@@ -489,11 +668,19 @@ def test_engine_profile_emits_step_profiles():
 # --------------------------------------------------------------------- #
 # structured logging
 # --------------------------------------------------------------------- #
+def _reset_thread_spans():
+    """Start the calling thread's span view clean: earlier suite
+    activity (e.g. an op handle a test never synchronized) may have
+    left a genuinely-open span on the global tracer."""
+    observe.get_tracer()._tls.stack = []
+
+
 def test_json_log_format(monkeypatch, capsys):
     """BLUEFOG_LOG_FORMAT=json: one JSON object per line with
     rank/timestamp/level."""
     import bluefog_tpu.logging_util as LU
 
+    _reset_thread_spans()
     monkeypatch.setenv("BLUEFOG_LOG_FORMAT", "json")
     monkeypatch.setenv("BLUEFOG_TPU_PROCESS_ID", "3")
     monkeypatch.setattr(LU, "_logger", None)  # rebuild with the env
@@ -512,3 +699,122 @@ def test_json_log_format(monkeypatch, capsys):
     assert obj["msg"] == "queue prefill is full"
     assert obj["logger"] == "bluefog_tpu"
     assert isinstance(obj["ts"], float)
+    assert "span" not in obj and "track" not in obj  # no open span
+
+
+def test_json_log_carries_span_correlation(monkeypatch, capsys):
+    """ISSUE 5 satellite: a JSON log line emitted INSIDE an open tracer
+    span carries span/track fields, so structured logs join against
+    the Chrome trace; outside any span the fields are absent."""
+    import bluefog_tpu.logging_util as LU
+
+    _reset_thread_spans()
+    monkeypatch.setenv("BLUEFOG_LOG_FORMAT", "json")
+    monkeypatch.setattr(LU, "_logger", None)
+    logger = LU.get_logger()
+    tr = observe.get_tracer()
+    try:
+        with tr.span("train", "train_step"):
+            with tr.span("train", "combine"):
+                logger.warning("inside nested span")
+            logger.warning("inside outer span")
+        logger.warning("outside any span")
+        err = capsys.readouterr().err
+    finally:
+        for h in list(logger.handlers):
+            logger.removeHandler(h)
+        monkeypatch.setattr(LU, "_logger", None)
+    objs = [json.loads(ln) for ln in err.splitlines() if ln.strip()]
+    nested, outer, outside = objs[-3:]
+    assert (nested["track"], nested["span"]) == ("train", "combine")
+    assert (outer["track"], outer["span"]) == ("train", "train_step")
+    assert "span" not in outside and "track" not in outside
+
+
+def test_json_log_span_from_another_thread(monkeypatch, capsys):
+    """Per-THREAD correlation: a worker thread logging inside its own
+    span gets its own track/span, not the main thread's."""
+    import bluefog_tpu.logging_util as LU
+
+    monkeypatch.setenv("BLUEFOG_LOG_FORMAT", "json")
+    monkeypatch.setattr(LU, "_logger", None)
+    logger = LU.get_logger()
+    tr = observe.get_tracer()
+    try:
+        def worker():
+            with tr.span("serving", "decode"):
+                logger.warning("from worker")
+
+        with tr.span("train", "train_step"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        err = capsys.readouterr().err
+    finally:
+        for h in list(logger.handlers):
+            logger.removeHandler(h)
+        monkeypatch.setattr(LU, "_logger", None)
+    obj = json.loads([ln for ln in err.splitlines() if ln.strip()][-1])
+    assert (obj["track"], obj["span"]) == ("serving", "decode")
+
+
+# --------------------------------------------------------------------- #
+# bench regression gate
+# --------------------------------------------------------------------- #
+def test_bench_headline_extraction():
+    from bluefog_tpu.benchutil import bench_headline
+
+    raw = {"metric": "resnet", "value": 2746.5, "unit": "img/s/chip",
+           "vs_baseline": 10.2, "mfu": 0.335,
+           "flops_per_step_per_device": 3e12}
+    assert bench_headline(raw) == {"value": 2746.5, "mfu": 0.335,
+                                   "vs_baseline": 10.2}
+    # the driver's BENCH_*.json wrapper
+    assert bench_headline({"n": 5, "parsed": raw}) == bench_headline(raw)
+    # serving_bench's sectioned record
+    serving = {"bench": "serving_poisson",
+               "continuous": {"tokens_per_sec": 1056.0, "ttft_p99": 0.4,
+                              "latency_p99": 1.2},
+               "static": {"tokens_per_sec": 901.0},
+               "speedup_tokens_per_sec": 1.17}
+    h = bench_headline(serving)
+    assert h["continuous.tokens_per_sec"] == 1056.0
+    assert h["continuous.ttft_p99"] == 0.4
+    assert h["speedup_tokens_per_sec"] == 1.17
+
+
+def test_bench_compare_direction_and_tolerance(tmp_path, capsys):
+    from bluefog_tpu.benchutil import bench_compare, bench_regression_gate
+
+    prev = {"value": 1000.0, "mfu": 0.30,
+            "continuous": {"ttft_p99": 0.10}}
+    # within 5% tolerance both ways -> ok
+    ok, rows = bench_compare(
+        {"value": 960.0, "mfu": 0.29,
+         "continuous": {"ttft_p99": 0.104}}, prev)
+    assert ok and len(rows) == 3
+    # throughput regression beyond tolerance -> fails
+    ok, rows = bench_compare({"value": 900.0, "mfu": 0.30,
+                              "continuous": {"ttft_p99": 0.10}}, prev)
+    assert not ok
+    assert [r["name"] for r in rows if r["regressed"]] == ["value"]
+    # p99 is lower-better: a big INCREASE fails, a decrease never does
+    ok, _ = bench_compare({"value": 1000.0, "mfu": 0.30,
+                           "continuous": {"ttft_p99": 0.2}}, prev)
+    assert not ok
+    ok, _ = bench_compare({"value": 1500.0, "mfu": 0.9,
+                           "continuous": {"ttft_p99": 0.01}}, prev)
+    assert ok  # improvements never fail the gate
+    # per-metric tolerance override
+    ok, _ = bench_compare({"value": 900.0, "mfu": 0.30,
+                           "continuous": {"ttft_p99": 0.10}}, prev,
+                          tolerances={"value": 0.2})
+    assert ok
+
+    # the file-based gate prints the one-line delta table
+    prev_path = tmp_path / "prev.json"
+    prev_path.write_text(json.dumps(prev))
+    assert not bench_regression_gate({"value": 900.0}, str(prev_path))
+    out = capsys.readouterr().out
+    assert "[bench-gate]" in out and "REGRESSED" in out
+    assert out.count("\n") == 1  # ONE line
