@@ -20,14 +20,26 @@ table and exits nonzero.  ``--out`` additionally writes the fresh
 record to a file, so the next run has something to gate against —
 SKIPPED when the gate fails, so a regressed run can never overwrite
 the baseline it was gated against.
+
+The gate is wired into the bench driver flow by DEFAULT: when the
+committed baseline ``benchmarks/bench_baseline.json`` (the pre-ISSUE-6
+r05 record) exists and ``--compare`` is not given, the run gates
+against it automatically — a plain ``python bench.py`` IS the
+regression gate (``--compare ''`` opts out).
 """
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# the committed pre-PR baseline the driver-flow gate compares against
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "benchmarks", "bench_baseline.json")
 
 REFERENCE_IMG_PER_SEC_PER_CHIP = 4310.6 / 16  # docs/performance.rst:15-23
 # 128/chip keeps the MXU saturated on v5e (measured: 64 -> 1737 img/s,
@@ -41,14 +53,23 @@ TIMED_WINDOWS = 3  # report the median window (tunnel hiccups skew means)
 
 def parse_args(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--compare", metavar="PREV.json", default=None,
+    ap.add_argument("--compare", metavar="PREV.json",
+                    default=(DEFAULT_BASELINE
+                             if os.path.exists(DEFAULT_BASELINE)
+                             else None),
                     help="gate this run against a prior bench record; "
-                         "exits 1 on regression beyond --tolerance")
+                         "exits 1 on regression beyond --tolerance "
+                         "(default: the committed "
+                         "benchmarks/bench_baseline.json when present; "
+                         "pass an empty string to disable)")
     ap.add_argument("--tolerance", type=float, default=0.05,
                     help="per-metric relative regression tolerance")
     ap.add_argument("--out", default=None,
                     help="also write the fresh record to this JSON file")
-    return ap.parse_args(argv)
+    args = ap.parse_args(argv)
+    if args.compare == "":
+        args.compare = None
+    return args
 
 
 def main(argv=None):

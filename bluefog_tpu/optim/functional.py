@@ -26,6 +26,26 @@ Key design points (SURVEY.md §7 "hard parts"):
 
 Combine math is f32-accumulated via the shard-level kernels in
 ``bluefog_tpu.parallel.collectives``.
+
+**Fused per-bucket epilogue pipeline** (default since ISSUE 6): the
+skip guard's isfinite reduce, the HealthVector's norms, wire
+quantization, and the consensus distance used to each re-traverse the
+full param tree around the same neighbor exchange — pure non-collective
+overhead stacked on the hot path (the flat 2723→2746 img/s/chip BENCH
+trajectory across r01–r05).  The builder now plans the param tree into
+fusion buckets (``optim.fusion.EpiloguePlan`` — one bucket per leaf on
+the plain path, size-balanced buckets under ``overlap="bucketed"``) and
+emits ONE composed closure per bucket running quantize → exchange →
+dequantize → guard-select → health-norm over that bucket's leaves; the
+guard/health reductions are accumulated as per-bucket partials combined
+at the end, and the consensus distance is computed from the exchange's
+already-materialized pre/post buffers (no re-mix, no second tree walk).
+``BLUEFOG_FUSE_EPILOGUES=0`` restores the pre-fusion builders — the
+debugging escape hatch and the golden reference of the epilogue parity
+matrix (tests/test_epilogue.py).  The fused combine weights ride as
+TRACED OPERANDS in both the guarded and unguarded builds, so the two
+share one association order: the uniform-weight static-CTA constant-
+fold 1-ulp caveat of the pre-fusion path (CHANGES.md PR 3) is gone.
 """
 
 from __future__ import annotations
@@ -41,6 +61,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bluefog_tpu import config as _config
 from bluefog_tpu.optim import fusion as _fusion
 from bluefog_tpu.parallel import collectives as C
 from bluefog_tpu.topology.spec import DynamicTopology, Topology
@@ -190,6 +211,46 @@ def _all_finite(loss: jax.Array, updates: Any) -> jax.Array:
     return ok
 
 
+def _grouped_sq_sum(leaves, groups) -> jax.Array:
+    """f32 sum of squares over inexact leaves, accumulated as
+    per-bucket partials in plan order — the epilogue pipeline's
+    incremental form of :func:`_tree_sq_sum`.  Groups partition the
+    leaves in tree order, so the accumulation association is identical
+    to the flat walk (bitwise-equal totals)."""
+    acc = jnp.zeros((), jnp.float32)
+    for g in groups:
+        for i in g:
+            leaf = jnp.asarray(leaves[i])
+            if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                acc = acc + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return acc
+
+
+def _grouped_all_finite(loss: jax.Array, upd_leaves, groups) -> jax.Array:
+    """The guard's isfinite reduce as per-bucket partials combined at
+    the end (boolean AND is associative — same flag as
+    :func:`_all_finite`), so the reduce fuses into the same per-bucket
+    pass as the norms instead of a separate full-tree walk."""
+    ok = jnp.all(jnp.isfinite(loss))
+    for g in groups:
+        part = jnp.bool_(True)
+        for i in g:
+            leaf = jnp.asarray(upd_leaves[i])
+            if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                part = part & jnp.all(jnp.isfinite(leaf))
+        ok = ok & part
+    return ok
+
+
+def _bucket_cons_sq(pre_buf: jax.Array, out_buf: jax.Array) -> jax.Array:
+    """Squared consensus-distance partial of one bucket, from the
+    exchange's own pre/post buffers — the tensors the combine already
+    materializes, so no second tree walk and no re-mix survives in the
+    HLO."""
+    d = pre_buf.astype(jnp.float32) - out_buf.astype(jnp.float32)
+    return jnp.sum(jnp.square(d))
+
+
 def _make_health_vector(loss, grad_sq, updates, consensus,
                         skipped=None) -> "HealthVector":
     """The per-rank HealthVector (traced scalars), shared by the
@@ -205,6 +266,39 @@ def _make_health_vector(loss, grad_sq, updates, consensus,
         update_norm=jnp.sqrt(_tree_sq_sum(updates)),
         skipped=jnp.asarray(skipped, jnp.float32),
         consensus=jnp.asarray(consensus, jnp.float32))
+
+
+def _loss_and_grads(loss_fn, has_aux, sp_axis, pp_axis, param_specs,
+                    params, aux, batch):
+    """Forward+backward with the cross-axis reductions every builder
+    shares: sp shards pmean grads/loss (params replicated over sp, each
+    shard saw a different sequence slice); pp psums the last-stage-
+    masked loss and restores pp-replicated leaves' gradients (the
+    layer stacks sharded over pp got exact stage-local gradients
+    through the reversed ppermutes — no reduction for those)."""
+    if has_aux:
+        (loss, new_aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, aux, batch)
+    else:
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        new_aux = aux
+    if sp_axis is not None:
+        grads = lax.pmean(grads, sp_axis)
+        loss = lax.pmean(loss, sp_axis)
+    if pp_axis is not None:
+        loss = lax.psum(loss, pp_axis)
+
+        def _pp_reduce(g, spec):
+            names = set()
+            for el in spec:
+                if isinstance(el, tuple):
+                    names.update(el)
+                elif el is not None:
+                    names.add(el)
+            return g if pp_axis in names else lax.psum(g, pp_axis)
+
+        grads = jax.tree.map(_pp_reduce, grads, param_specs)
+    return loss, grads, new_aux
 
 
 def _weighted_combine_fn(spec: CommSpec, axis_name: str,
@@ -583,6 +677,448 @@ def _observed_step(step_fn: Callable, labels: dict,
     return step
 
 
+def _build_fused_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    axis_name: str,
+    comm_mode: str,
+    specs: Sequence[CommSpec],
+    k_comm: int,
+    hierarchical_local_size: Optional[int],
+    sp_axis: Optional[str],
+    pp_axis: Optional[str],
+    batch_specs: Any,
+    param_specs: Any,
+    opt_state_specs: Any,
+    donate: bool,
+    has_aux: bool,
+    compress: Optional[str],
+    n_buckets: Optional[int],
+    guard: Optional[GuardConfig],
+    health: Optional[HealthConfig],
+) -> Callable:
+    """The fused per-bucket epilogue pipeline — the default
+    :func:`build_train_step` data plane (see its docstring for the
+    user contract, and the module docstring for the design).
+
+    One builder serves every feature combination: the param tree is
+    planned into fusion buckets (``EpiloguePlan`` — one bucket per leaf
+    on the plain path, size-balanced under ``overlap='bucketed'``) and
+    each bucket runs its epilogue stages (quantize → exchange →
+    dequantize → guard-select → health-norm → consensus) as one
+    composed pass, for every comm mode including push_sum.  The
+    guard's isfinite reduce and the health norms accumulate as
+    per-bucket partials in plan order (bitwise-equal to the flat walk);
+    the consensus distance reuses the exchange's own pre/post bucket
+    buffers.  The cta/atc combine weights are TRACED OPERANDS in the
+    guarded AND unguarded builds, so both share one association order
+    (the pre-fusion uniform-weight static-CTA constant-fold caveat is
+    gone) and topology healing swaps weight data without recompiling
+    either variant."""
+    guarded = guard is not None
+    want_health = health is not None
+    want_cons = want_health and health.consensus
+    neighbor = comm_mode in ("cta", "atc") and bool(specs)
+    # traced combine-weight operands: the flat neighbor exchange only —
+    # hierarchical weights are machine-level constants, push_sum derives
+    # its column-stochastic scales from the edge structure
+    use_traced_w = neighbor and hierarchical_local_size is None
+    wire = compress == "int8_sr"
+    wire_compress = "int8" if wire else compress
+    zero = lambda: jnp.zeros((), jnp.float32)
+
+    def _plan(leaves):
+        return _fusion.EpiloguePlan.for_leaves(
+            leaves, n_buckets, compress=compress, guard=guarded,
+            health=want_health, consensus=want_cons)
+
+    def _fused_combine_branch(spec: CommSpec) -> Callable:
+        """fn(tree, key, w) -> (combined_tree, cons_sq): the per-bucket
+        pipeline over an already-materialized param tree (cta pre-
+        update; guarded/plain atc post-update)."""
+
+        def fn(tree, key, w):
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            if not leaves:
+                return tree, zero()
+            plan = _plan(leaves)
+            outs = [None] * len(leaves)
+            cons = zero()
+            for b in plan.buckets:
+                pre = _pack_bucket(leaves, list(b.leaves))
+                if hierarchical_local_size is not None:
+                    out = C.hierarchical_neighbor_allreduce(
+                        pre, spec, hierarchical_local_size, axis_name)
+                else:
+                    cw, sw = w
+                    out = C.neighbor_allreduce(
+                        pre, spec, axis_name, compress=wire_compress,
+                        wire_key=(jax.random.fold_in(key, b.index)
+                                  if wire else None),
+                        class_weights=cw, self_weights=sw)
+                if want_cons and jnp.issubdtype(jnp.dtype(b.dtype),
+                                                jnp.inexact):
+                    cons = cons + _bucket_cons_sq(pre, out)
+                _unpack_bucket(out, leaves, list(b.leaves), outs)
+            return jax.tree_util.tree_unflatten(treedef, outs), cons
+
+        return fn
+
+    def _fused_apply_combine_branch(spec: CommSpec) -> Callable:
+        """fn((params, updates), key, w) -> (params, cons_sq): the
+        unguarded ATC pipeline — bucket *i*'s optax apply feeds its own
+        exchange before bucket *i+1*'s apply, and the consensus partial
+        comes from the bucket's applied/mixed buffers (the pre-fusion
+        path re-applied the full update tree just to measure it)."""
+
+        def fn(operand, key, w):
+            params, updates = operand
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            upd_leaves = jax.tree_util.tree_flatten(updates)[0]
+            if not leaves:
+                return params, zero()
+            plan = _plan(leaves)
+            outs = [None] * len(leaves)
+            cons = zero()
+            for b in plan.buckets:
+                g = list(b.leaves)
+                fresh = list(leaves)
+                for i in g:
+                    fresh[i] = optax.apply_updates(leaves[i],
+                                                   upd_leaves[i])
+                pre = _pack_bucket(fresh, g)
+                if hierarchical_local_size is not None:
+                    out = C.hierarchical_neighbor_allreduce(
+                        pre, spec, hierarchical_local_size, axis_name)
+                else:
+                    cw, sw = w
+                    out = C.neighbor_allreduce(
+                        pre, spec, axis_name, compress=wire_compress,
+                        wire_key=(jax.random.fold_in(key, b.index)
+                                  if wire else None),
+                        class_weights=cw, self_weights=sw)
+                if want_cons and jnp.issubdtype(jnp.dtype(b.dtype),
+                                                jnp.inexact):
+                    cons = cons + _bucket_cons_sq(pre, out)
+                _unpack_bucket(out, fresh, g, outs)
+            return jax.tree_util.tree_unflatten(treedef, outs), cons
+
+        return fn
+
+    def _fused_push_sum_branch(spec: CommSpec) -> Callable:
+        """fn((params, ps)) -> (debiased, mixed_ps, cons_sq): the
+        push-sum pipeline — bias, mix, and de-bias run on bucket
+        buffers (the extended payload [buckets ‖ ps] mixes as a unit,
+        column-stochastic scales from the edge structure), with the
+        consensus partial from the same pre/post buffers."""
+
+        def fn(operand):
+            params, ps = operand
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            if not leaves:
+                return params, ps, zero()
+            plan = _plan(leaves)
+            bufs = [_pack_bucket(leaves, list(b.leaves))
+                    for b in plan.buckets]
+            # re-bias -> mix -> de-bias stays in f32 (see the unfused
+            # combine_push_sum for the digraph-correctness rationale);
+            # push_sum_mix takes any pytree, so the bucket-buffer list
+            # mixes as one extended payload [buckets ‖ ps] — column-
+            # stochastic mixing distributes over concatenation, each
+            # bucket its own independent collective
+            biased = [buf.astype(jnp.float32) * ps for buf in bufs]
+            mixed, mixed_ps = C.push_sum_mix(biased, ps, spec,
+                                             axis_name)
+            outs = [None] * len(leaves)
+            cons = zero()
+            for b, pre, mix in zip(plan.buckets, bufs, mixed):
+                deb = (mix / mixed_ps).astype(jnp.dtype(b.dtype))
+                if want_cons and jnp.issubdtype(jnp.dtype(b.dtype),
+                                                jnp.inexact):
+                    cons = cons + _bucket_cons_sq(pre, deb)
+                _unpack_bucket(deb, leaves, list(b.leaves), outs)
+            return (jax.tree_util.tree_unflatten(treedef, outs),
+                    mixed_ps, cons)
+
+        return fn
+
+    branches = [_fused_combine_branch(s) for s in specs] \
+        if neighbor else []
+    # the interleaved apply+exchange rides the BUCKETED unguarded atc
+    # path only: on the plain path the whole-tree apply stays outside
+    # the combine (and outside any lax.switch branch) so the healthy
+    # arithmetic is bit-identical to the pre-fusion builder — an apply
+    # moved inside a conditional invites a different mul+add
+    # contraction (1-ulp) on some backends
+    ac_branches = [_fused_apply_combine_branch(s) for s in specs] \
+        if (neighbor and comm_mode == "atc" and not guarded
+            and n_buckets is not None) else []
+    ps_branches = [_fused_push_sum_branch(s) for s in specs] \
+        if comm_mode == "push_sum" else []
+
+    def fused_combine(params, step, comm_weights):
+        if not branches:
+            return params, zero()
+
+        def run(params):
+            key = jax.random.fold_in(jax.random.PRNGKey(0x51EED), step)
+            if len(branches) == 1:
+                return branches[0](params, key,
+                                   comm_weights[0] if use_traced_w
+                                   else ())
+            picked = [
+                (lambda fn, i: lambda p, k, ws: fn(
+                    p, k, ws[i] if use_traced_w else ()))(fn, i)
+                for i, fn in enumerate(branches)
+            ]
+            return lax.switch(step % len(branches), picked, params, key,
+                              comm_weights)
+
+        if k_comm > 1:
+            # lax.cond actually skips the collectives (and the epilogue
+            # stages riding them) on off-cycle steps
+            return lax.cond(step % k_comm == 0, run,
+                            lambda p: (p, zero()), params)
+        return run(params)
+
+    def fused_apply_then_combine(params, updates, step, comm_weights):
+        if not ac_branches:
+            return optax.apply_updates(params, updates), zero()
+
+        def run(operand):
+            params, updates = operand
+            key = jax.random.fold_in(jax.random.PRNGKey(0x51EED), step)
+            if len(ac_branches) == 1:
+                return ac_branches[0]((params, updates), key,
+                                      comm_weights[0] if use_traced_w
+                                      else ())
+            picked = [
+                (lambda fn, i: lambda op, k, ws: fn(
+                    op, k, ws[i] if use_traced_w else ()))(fn, i)
+                for i, fn in enumerate(ac_branches)
+            ]
+            return lax.switch(step % len(ac_branches), picked,
+                              (params, updates), key, comm_weights)
+
+        if k_comm > 1:
+            # off-cycle steps still apply the optax update — only the
+            # collectives (and their epilogue stages) are skipped
+            return lax.cond(
+                step % k_comm == 0, run,
+                lambda op: (optax.apply_updates(op[0], op[1]), zero()),
+                (params, updates))
+        return run((params, updates))
+
+    def fused_push_sum(params, ps, step):
+        def run(operand):
+            if len(ps_branches) == 1:
+                return ps_branches[0](operand)
+            return lax.switch(step % len(ps_branches), ps_branches,
+                              operand)
+
+        if k_comm > 1:
+            return lax.cond(step % k_comm == 0, run,
+                            lambda op: (op[0], op[1], zero()),
+                            (params, ps))
+        return run((params, ps))
+
+    def per_rank_step(params, aux, opt_state, batch, step, comm_weights):
+        loss, grads, new_aux = _loss_and_grads(
+            loss_fn, has_aux, sp_axis, pp_axis, param_specs,
+            params, aux, batch)
+        groups = _plan(jax.tree.leaves(params)).groups \
+            if (want_health or guarded) else None
+        # local (pre-allreduce) gradient norm as per-bucket partials
+        grad_sq = _grouped_sq_sum(jax.tree.leaves(grads), groups) \
+            if want_health else None
+        cons = zero()
+        if comm_mode == "gradient_allreduce":
+            # (guarded note: the allreduce mixes GRADIENTS, so one
+            # rank's NaN reaches every rank — the guard skips globally;
+            # the neighbor modes contain the blast radius)
+            grads = jax.tree.map(
+                lambda g: C.allreduce(g, axis_name, average=True), grads)
+        if comm_mode == "push_sum":
+            base_state, ps = opt_state
+            params, ps, cons = fused_push_sum(params, ps, step)
+            updates, base_state = optimizer.update(grads, base_state,
+                                                   params)
+            params = optax.apply_updates(params, updates)
+            hv = _fused_health(loss, grad_sq, updates, groups, cons,
+                               None) if want_health else None
+            return params, new_aux, (base_state, ps), loss, None, hv
+        if comm_mode == "cta":
+            params, cons = fused_combine(params, step, comm_weights)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        skipped = None
+        if guarded:
+            ok = _grouped_all_finite(
+                loss, jax.tree_util.tree_flatten(updates)[0], groups)
+
+            # elementwise select, NOT lax.cond — see the unfused
+            # guarded builder for why (bit-identity + mul+add fusion)
+            def pick(new, old):
+                return jnp.where(ok, new, old)
+
+            params = jax.tree.map(
+                pick, optax.apply_updates(params, updates), params)
+            new_aux = jax.tree.map(pick, new_aux, aux)
+            new_opt = jax.tree.map(pick, new_opt, opt_state)
+            if comm_mode == "atc":
+                params, cons = fused_combine(params, step, comm_weights)
+            skipped = jnp.where(ok, jnp.int32(0), jnp.int32(1))
+        else:
+            if comm_mode == "atc" and ac_branches:
+                params, cons = fused_apply_then_combine(
+                    params, updates, step, comm_weights)
+            else:
+                params = optax.apply_updates(params, updates)
+                if comm_mode == "atc":
+                    params, cons = fused_combine(params, step,
+                                                 comm_weights)
+        hv = _fused_health(loss, grad_sq, updates, groups, cons,
+                           skipped) if want_health else None
+        return params, new_aux, new_opt, loss, skipped, hv
+
+    def _fused_health(loss, grad_sq, updates, groups, cons_sq, skipped):
+        upd_leaves = jax.tree_util.tree_flatten(updates)[0]
+        if skipped is None:
+            ok = _grouped_all_finite(loss, upd_leaves, groups)
+            skipped = jnp.where(ok, jnp.float32(0), jnp.float32(1))
+        return HealthVector(
+            loss=jnp.asarray(loss, jnp.float32),
+            grad_norm=jnp.sqrt(grad_sq),
+            update_norm=jnp.sqrt(_grouped_sq_sum(upd_leaves, groups)),
+            skipped=jnp.asarray(skipped, jnp.float32),
+            consensus=jnp.sqrt(cons_sq))
+
+    squeeze = lambda t: jax.tree.map(lambda x: x[0], t)
+    expand = lambda t: jax.tree.map(lambda x: x[None], t)
+
+    def wrapped(params, aux, opt_state, batch, step, comm_weights):
+        params, aux, opt_state, loss, skipped, hv = per_rank_step(
+            squeeze(params), squeeze(aux), squeeze(opt_state),
+            squeeze(batch), step, comm_weights)
+        outs = (expand(params), expand(aux), expand(opt_state),
+                jnp.reshape(loss, (1,)))
+        if guarded:
+            outs = outs + (jnp.reshape(skipped, (1,)),)
+        if want_health:
+            outs = outs + (HealthVector(
+                *[jnp.reshape(x, (1,)) for x in hv]),)
+        return outs
+
+    p_rank = P(axis_name)
+    if batch_specs is None:
+        batch_specs = p_rank
+    p_params = param_specs if param_specs is not None else p_rank
+    p_opt = opt_state_specs if opt_state_specs is not None else p_rank
+    # comm weights ride replicated (every rank reads the full tables)
+    p_comm = tuple((P(), P()) for _ in specs) if use_traced_w else ()
+    out_specs = (p_params, p_rank, p_opt, p_rank)
+    if guarded:
+        out_specs = out_specs + (p_rank,)
+    if want_health:
+        out_specs = out_specs + (p_rank,)  # spec prefix over HealthVector
+    sm = jax.shard_map(
+        wrapped,
+        mesh=mesh,
+        in_specs=(p_params, p_rank, p_opt, batch_specs, P(), p_comm),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    donate_argnums = (0, 1, 2) if donate else ()
+    jitted = jax.jit(sm, donate_argnums=donate_argnums)
+    default_w = comm_weight_inputs(specs) if use_traced_w else ()
+
+    obs_labels = dict(
+        comm_mode=comm_mode,
+        overlap="bucketed" if n_buckets is not None else "none",
+        guarded="true" if guarded else "false")
+    needs_topo = comm_mode in ("cta", "atc", "push_sum")
+    edge_traffic = (list(specs), 4 if has_aux else 3, k_comm,
+                    int(mesh.shape[axis_name]),
+                    comm_mode == "push_sum") \
+        if (specs and needs_topo) else None
+
+    stages = _fusion.epilogue_stages(
+        compress=compress, guard=guarded, health=want_health,
+        consensus=want_cons)
+
+    def _decorate(step_fn, lower):
+        step_fn.jitted = jitted
+        step_fn.lower = lower
+        step_fn.health_config = health
+        step_fn.epilogue_stages = stages
+        step_fn.has_aux = has_aux
+        if guarded:
+            step_fn.guard_config = guard
+        if guarded or use_traced_w:
+            step_fn.default_comm_weights = default_w
+        return step_fn
+
+    if guarded:
+        if has_aux:
+            def aux_step(params, aux, opt_state, batch, step,
+                         comm_weights):
+                return jitted(params, aux, opt_state, batch, step,
+                              comm_weights)
+
+            return _decorate(
+                _observed_step(aux_step, obs_labels, edge_traffic),
+                lambda params, aux, opt_state, batch, step,
+                comm_weights: jitted.lower(params, aux, opt_state,
+                                           batch, step, comm_weights))
+
+        if health is None:
+            def no_aux_step(params, opt_state, batch, step,
+                            comm_weights):
+                params, _, opt_state, loss, skipped = jitted(
+                    params, (), opt_state, batch, step, comm_weights)
+                return params, opt_state, loss, skipped
+        else:
+            def no_aux_step(params, opt_state, batch, step,
+                            comm_weights):
+                params, _, opt_state, loss, skipped, hv = jitted(
+                    params, (), opt_state, batch, step, comm_weights)
+                return params, opt_state, loss, skipped, hv
+
+        return _decorate(
+            _observed_step(no_aux_step, obs_labels, edge_traffic),
+            lambda params, opt_state, batch, step, comm_weights:
+            jitted.lower(params, (), opt_state, batch, step,
+                         comm_weights))
+
+    if has_aux:
+        def aux_step(params, aux, opt_state, batch, step):
+            return jitted(params, aux, opt_state, batch, step,
+                          default_w)
+
+        return _decorate(
+            _observed_step(aux_step, obs_labels, edge_traffic),
+            lambda params, aux, opt_state, batch, step:
+            jitted.lower(params, aux, opt_state, batch, step,
+                         default_w))
+
+    if health is None:
+        def no_aux_step(params, opt_state, batch, step):
+            params, _, opt_state, loss = jitted(
+                params, (), opt_state, batch, step, default_w)
+            return params, opt_state, loss
+    else:
+        def no_aux_step(params, opt_state, batch, step):
+            params, _, opt_state, loss, hv = jitted(
+                params, (), opt_state, batch, step, default_w)
+            return params, opt_state, loss, hv
+
+    return _decorate(
+        _observed_step(no_aux_step, obs_labels, edge_traffic),
+        lambda params, opt_state, batch, step:
+        jitted.lower(params, (), opt_state, batch, step, default_w))
+
+
 def build_train_step(
     loss_fn: Callable[[Any, Any], jax.Array],
     optimizer: optax.GradientTransformation,
@@ -699,6 +1235,21 @@ def build_train_step(
     the step bit-identical to a pre-feature build.  Composes with
     ``guard=`` (``skipped`` then carries the guard's actual flags).
 
+    **Fused epilogue pipeline** (default): every feature above is
+    emitted as a per-bucket stage of ONE composed pass per fusion-plan
+    bucket — quantize → exchange → dequantize → guard-select →
+    health-norm — instead of separate full-tree walks around the
+    exchange (see the module docstring).  All comm modes ride it,
+    including ``push_sum`` (whose exchange now also accepts
+    ``overlap="bucketed"``); the cta/atc combine weights are traced
+    operands in BOTH the guarded and unguarded builds, so the two share
+    one association order (guarded == unguarded bitwise on every
+    topology, including uniform-weight static CTA) and healing swaps
+    weight data without recompiling either.  Set
+    ``BLUEFOG_FUSE_EPILOGUES=0`` to fall back to the pre-fusion
+    builders (debugging escape hatch; also the golden reference of
+    tests/test_epilogue.py's parity matrix).
+
     Returns ``train_step(params, opt_state, batch, step) ->
     (params, opt_state, loss)`` — all rank-major, jit-compiled with
     params/opt_state donated.  Under ``guard=`` the signature is
@@ -747,12 +1298,11 @@ def build_train_step(
                 "delivers rank-level weight data; the hierarchical "
                 "combine takes machine-level weights)")
     if overlap == "bucketed":
-        if comm_mode not in ("cta", "atc"):
+        if comm_mode not in ("cta", "atc", "push_sum"):
             raise ValueError(
-                "overlap='bucketed' buckets the cta/atc neighbor combine "
-                f"only (got comm_mode={comm_mode!r}); gradient_allreduce "
-                "relies on XLA's all-reduce combiner and push_sum mixes "
-                "an extended payload that must stay whole")
+                "overlap='bucketed' buckets the cta/atc/push_sum "
+                f"neighbor exchange only (got comm_mode={comm_mode!r}); "
+                "gradient_allreduce relies on XLA's all-reduce combiner")
         if overlap_buckets < 1:
             raise ValueError(
                 f"overlap_buckets must be >= 1, got {overlap_buckets}")
@@ -761,6 +1311,23 @@ def build_train_step(
 
     specs = list(schedule) if schedule is not None else (
         [topology] if topology is not None else [])
+    if _config.fuse_epilogues():
+        return _build_fused_train_step(
+            loss_fn, optimizer, mesh, axis_name=axis_name,
+            comm_mode=comm_mode, specs=specs,
+            k_comm=int(num_steps_per_communication),
+            hierarchical_local_size=hierarchical_local_size,
+            sp_axis=sp_axis, pp_axis=pp_axis, batch_specs=batch_specs,
+            param_specs=param_specs, opt_state_specs=opt_state_specs,
+            donate=donate, has_aux=has_aux, compress=compress,
+            n_buckets=overlap_buckets if bucketed else None,
+            guard=guard, health=health)
+    # ------- BLUEFOG_FUSE_EPILOGUES=0: the pre-fusion builders -------
+    if comm_mode == "push_sum" and bucketed:
+        raise ValueError(
+            "overlap='bucketed' with comm_mode='push_sum' needs the "
+            "fused epilogue pipeline (unset BLUEFOG_FUSE_EPILOGUES=0): "
+            "the unfused builder mixes the extended payload whole")
     if guard is not None:
         return _build_guarded_train_step(
             loss_fn, optimizer, mesh, guard=guard, axis_name=axis_name,
@@ -867,37 +1434,9 @@ def build_train_step(
         return run((params, updates))
 
     def per_rank_step(params, aux, opt_state, batch, step):
-        if has_aux:
-            (loss, new_aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, aux, batch)
-        else:
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-            new_aux = aux
-        if sp_axis is not None:
-            # Params are replicated over the sequence axis; each sp shard
-            # saw a different sequence slice, so reduce both.
-            grads = lax.pmean(grads, sp_axis)
-            loss = lax.pmean(loss, sp_axis)
-        if pp_axis is not None:
-            # Pipeline parallelism (llama_pp_loss_fn / gpipe): the loss is
-            # masked to the last stage, so a SUM over the axis recovers it
-            # everywhere.  Leaves sharded over pp (the layer stacks) got
-            # exact stage-local gradients through the reversed ppermutes —
-            # no reduction; pp-replicated leaves (embedding/head) got their
-            # gradient on exactly one stage and zeros elsewhere — psum
-            # restores the replicated update.
-            loss = lax.psum(loss, pp_axis)
-
-            def _pp_reduce(g, spec):
-                names = set()
-                for el in spec:
-                    if isinstance(el, tuple):
-                        names.update(el)
-                    elif el is not None:
-                        names.add(el)
-                return g if pp_axis in names else lax.psum(g, pp_axis)
-
-            grads = jax.tree.map(_pp_reduce, grads, param_specs)
+        loss, grads, new_aux = _loss_and_grads(
+            loss_fn, has_aux, sp_axis, pp_axis, param_specs,
+            params, aux, batch)
         # local (pre-allreduce) gradient norm: the per-rank attribution
         # signal the fleet layer gossips
         grad_sq = _tree_sq_sum(grads) if health is not None else None
@@ -1068,28 +1607,9 @@ def _build_guarded_train_step(
         return run(params)
 
     def per_rank_step(params, aux, opt_state, batch, step, comm_weights):
-        if has_aux:
-            (loss, new_aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, aux, batch)
-        else:
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-            new_aux = aux
-        if sp_axis is not None:
-            grads = lax.pmean(grads, sp_axis)
-            loss = lax.pmean(loss, sp_axis)
-        if pp_axis is not None:
-            loss = lax.psum(loss, pp_axis)
-
-            def _pp_reduce(g, spec):
-                names = set()
-                for el in spec:
-                    if isinstance(el, tuple):
-                        names.update(el)
-                    elif el is not None:
-                        names.add(el)
-                return g if pp_axis in names else lax.psum(g, pp_axis)
-
-            grads = jax.tree.map(_pp_reduce, grads, param_specs)
+        loss, grads, new_aux = _loss_and_grads(
+            loss_fn, has_aux, sp_axis, pp_axis, param_specs,
+            params, aux, batch)
         grad_sq = _tree_sq_sum(grads) if health is not None else None
         consensus = jnp.zeros((), jnp.float32)
         if comm_mode == "gradient_allreduce":

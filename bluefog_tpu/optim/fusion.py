@@ -28,6 +28,7 @@ hierarchical): the weighted combine distributes over concatenation.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from typing import Any, Dict, List, Sequence, Tuple
 
@@ -40,8 +41,109 @@ __all__ = [
     "size_balanced_threshold",
     "leaf_signature",
     "bucket_signature",
+    "epilogue_stages",
+    "EpilogueBucket",
+    "EpiloguePlan",
+    "EPILOGUE_STAGE_ORDER",
     "FusionPlan",
 ]
+
+# Canonical stage order of the fused per-bucket epilogue pipeline
+# (build_train_step's jitted fast path).  Every feature that used to
+# re-traverse the full param tree around the exchange is expressed as a
+# per-bucket stage instead, so the compiler sees ONE composed pass over
+# each bucket's leaves — HiCCL's composable-primitive decomposition
+# applied to the train-step epilogue (PAPERS.md: HiCCL):
+#
+#   pack         gather the bucket's leaves into one flat buffer
+#   quantize     wire compression encode (int8 absmax / bf16 round)
+#   exchange     the bucket's own neighbor collective
+#   dequantize   wire decode + weighted combine (f32 accumulation)
+#   guard_select per-rank skip: elementwise select against last-good
+#   health_norm  partial grad/update sq-sums for the HealthVector
+#   consensus    partial ||pre - mixed||^2 from the exchange's own
+#                buffers (no re-mix, no second tree walk)
+#   unpack       scatter the combined buffer back to leaf shapes
+EPILOGUE_STAGE_ORDER = (
+    "pack", "quantize", "exchange", "dequantize", "guard_select",
+    "health_norm", "consensus", "unpack",
+)
+
+
+def epilogue_stages(compress=None, guard: bool = False,
+                    health: bool = False,
+                    consensus: bool = False) -> Tuple[str, ...]:
+    """The epilogue stage list a feature combination composes to, in
+    canonical order.  ``pack``/``exchange``/``unpack`` are always
+    present (a single-leaf bucket's pack/unpack are identity);
+    ``quantize``/``dequantize`` ride with wire compression,
+    ``guard_select`` with a GuardConfig, ``health_norm`` with a
+    HealthConfig, and ``consensus`` with ``HealthConfig.consensus``."""
+    on = {"pack", "exchange", "unpack"}
+    if compress:
+        on |= {"quantize", "dequantize"}
+    if guard:
+        on.add("guard_select")
+    if health:
+        on.add("health_norm")
+    if consensus:
+        on.add("consensus")
+    return tuple(s for s in EPILOGUE_STAGE_ORDER if s in on)
+
+
+@dataclasses.dataclass(frozen=True)
+class EpilogueBucket:
+    """One fusion-plan bucket plus the epilogue stage list that runs
+    over it as a single composed pass (the per-bucket closure
+    ``optim.functional`` emits)."""
+
+    index: int                  # bucket position in plan order
+    leaves: Tuple[int, ...]     # leaf indices, tree order
+    nbytes: int                 # per-shard payload bytes
+    dtype: str                  # uniform dtype of the bucket's leaves
+    stages: Tuple[str, ...]     # subset of EPILOGUE_STAGE_ORDER
+
+
+@dataclasses.dataclass(frozen=True)
+class EpiloguePlan:
+    """Trace-time plan of the fused per-bucket epilogue pipeline: the
+    grouping walk's buckets, each carrying its stage list.  Built by
+    :meth:`for_leaves` from the SAME grouping walk as the eager fusion
+    buffers and the overlap engine (``plan_groups``) — one bucket per
+    leaf when ``n_buckets`` is None (the plain, non-overlapped path:
+    per-tensor wire scales and no concat traffic), size-balanced
+    buckets otherwise."""
+
+    buckets: Tuple[EpilogueBucket, ...]
+    stages: Tuple[str, ...]
+
+    @classmethod
+    def for_leaves(cls, leaves, n_buckets, *, compress=None,
+                   guard: bool = False, health: bool = False,
+                   consensus: bool = False) -> "EpiloguePlan":
+        rows = bucket_signature(leaves)
+        if n_buckets is None:
+            groups = [[i] for i in range(len(rows))]
+        else:
+            threshold = size_balanced_threshold(rows, n_buckets)
+            groups = plan_groups(rows, threshold)
+        stages = epilogue_stages(compress=compress, guard=guard,
+                                 health=health, consensus=consensus)
+        buckets = tuple(
+            EpilogueBucket(
+                index=b,
+                leaves=tuple(g),
+                nbytes=sum(rows[i][0] for i in g),
+                dtype=rows[g[0]][1],
+                stages=stages)
+            for b, g in enumerate(groups))
+        return cls(buckets=buckets, stages=stages)
+
+    @property
+    def groups(self) -> List[List[int]]:
+        """The bare grouping (``plan_groups`` layout) for consumers
+        that only pack/unpack."""
+        return [list(b.leaves) for b in self.buckets]
 
 # (nbytes, dtype_str) per leaf — the only inputs the grouping walk sees.
 SizeDtype = Tuple[int, str]
@@ -132,6 +234,17 @@ class FusionPlan:
         ]
         groups = plan_groups(rows, threshold)
         self.groups = groups
+        # each bucket carries its epilogue stage list; the eager path's
+        # combine is uncompressed/unguarded, so the stages are the bare
+        # pack -> exchange -> unpack pipeline — the jitted builder
+        # constructs richer plans via EpiloguePlan.for_leaves
+        stages = epilogue_stages()
+        self.buckets = tuple(
+            EpilogueBucket(
+                index=b, leaves=tuple(g),
+                nbytes=sum(rows[i][0] for i in g),
+                dtype=rows[g[0]][1], stages=stages)
+            for b, g in enumerate(groups))
 
         def pack(leaves):
             n = leaves[0].shape[0]

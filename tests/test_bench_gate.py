@@ -1,0 +1,80 @@
+"""The bench regression gate is wired into the driver flow (ISSUE 6):
+a committed pre-PR baseline + a smoke test that the gate actually
+gates — exit 1 on a synthetic regressed record, exit 0 on the real
+committed before/after pair.
+"""
+
+import copy
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "benchmarks", "bench_baseline.json")
+
+pytestmark = pytest.mark.perf
+
+
+def _load(name):
+    with open(os.path.join(REPO, name)) as fh:
+        return json.load(fh)
+
+
+def test_committed_baseline_is_the_r05_record():
+    """The committed baseline IS the pre-ISSUE-6 driver record (r05
+    parsed line), so the driver-flow gate measures this PR's change
+    against the state it branched from."""
+    base = _load(os.path.join("benchmarks", "bench_baseline.json"))
+    r05 = _load("BENCH_r05.json")["parsed"]
+    assert base == r05
+    assert base["metric"] == "resnet50_train_images_per_sec_per_chip"
+    assert base["value"] > 0 and base["mfu"] > 0
+
+
+def test_gate_exits_nonzero_on_synthetic_regression(capsys):
+    """A 20% throughput/MFU drop beyond the 5% tolerance fails the
+    gate (bench.py exits 1 on a False gate result)."""
+    from bluefog_tpu.benchutil import bench_regression_gate
+
+    regressed = copy.deepcopy(_load(
+        os.path.join("benchmarks", "bench_baseline.json")))
+    regressed["value"] *= 0.8
+    regressed["mfu"] *= 0.8
+    ok = bench_regression_gate(regressed, BASELINE)
+    assert ok is False
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out
+
+
+def test_gate_passes_on_real_before_after_pair(capsys):
+    """The real committed r04 -> r05 pair (2738.2 -> 2746.5 img/s/chip,
+    an improvement) passes the gate: exit 0."""
+    from bluefog_tpu.benchutil import bench_compare
+
+    before = _load("BENCH_r04.json")
+    after = _load("BENCH_r05.json")
+    ok, rows = bench_compare(after, before)
+    assert ok is True
+    assert rows and not any(r["regressed"] for r in rows)
+    # and the fresh record gates clean against the committed baseline
+    from bluefog_tpu.benchutil import bench_regression_gate
+
+    assert bench_regression_gate(after, BASELINE) is True
+
+
+def test_bench_py_defaults_to_committed_baseline():
+    """A plain ``python bench.py`` (the driver's invocation) gates
+    against the committed baseline by default; ``--compare ''`` opts
+    out and an explicit path wins."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    args = bench.parse_args([])
+    assert args.compare == bench.DEFAULT_BASELINE
+    assert os.path.exists(args.compare)
+    assert bench.parse_args(["--compare", ""]).compare is None
+    assert bench.parse_args(["--compare", "x.json"]).compare == "x.json"

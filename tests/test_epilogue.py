@@ -1,0 +1,339 @@
+"""Fused per-bucket epilogue pipeline (ISSUE 6): parity matrix +
+association-order guarantees.
+
+Two contracts:
+
+* **Golden parity matrix** — for every feature combination
+  (guard x health x compress x comm_mode x overlap) the fused
+  pipeline's training trajectory matches the pre-fusion reference
+  builders (``BLUEFOG_FUSE_EPILOGUES=0``, the escape hatch that IS the
+  pre-refactor code): params/opt_state/loss/skip flags bit-identical,
+  HealthVector fields equal to f32 tolerance (the per-bucket consensus
+  and norm partials may associate reductions differently under
+  ``overlap="bucketed"``; on the plain path they accumulate in leaf
+  order and match bitwise too).
+
+  The matrix runs on a NON-uniform weighted static ring and on the
+  dynamic one-peer schedule: with uniform static weights the unfused
+  unguarded builder bakes the weight vector as a constant that XLA may
+  legally refactor (the documented PR-3 1-ulp fold), which is exactly
+  the behavior the fused path retires — covered by the dedicated test
+  below instead.
+
+* **Uniform-weight static CTA bit-identity** (the converted PR-3
+  caveat): the fused combine carries its weights as traced operands in
+  BOTH the guarded and unguarded builds, so the two share one
+  association order and are bit-identical on every topology —
+  including the uniform-weight static CTA case the pre-fusion test had
+  to exclude by design.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bluefog_tpu.optim import functional as F
+from bluefog_tpu.optim import fusion
+from bluefog_tpu.topology import (ExponentialTwoGraph,
+                                  one_peer_dynamic_schedule,
+                                  uniform_topology_spec)
+from bluefog_tpu.topology.spec import Topology
+
+N = 8
+_OPT = optax.sgd(0.05, momentum=0.9)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("bf",))
+
+
+def _weighted_ring():
+    """Non-uniform row-stochastic ring: no weight value repeats within
+    a row, so XLA cannot factor the unfused builder's constant-weight
+    combine — fused and unfused associate identically and the matrix
+    can assert bitwise equality."""
+    W = np.zeros((N, N))
+    for r in range(N):
+        W[(r - 1) % N, r] = 0.3
+        W[(r + 1) % N, r] = 0.1
+        W[r, r] = 0.6
+    return Topology.from_weight_matrix(W)
+
+
+def _weighted_schedule():
+    """The one-peer dynamic rounds with NON-uniform weights (self 0.7,
+    neighbor 0.3): the stock schedule's uniform 0.5/0.5 lets XLA fold
+    the unfused builder's constant-weight combine into (x+r)*0.5 —
+    the same association rewrite the static-CTA caveat documents —
+    so the bitwise matrix uses weights that cannot factor."""
+    from bluefog_tpu.topology.spec import DynamicTopology
+
+    out = []
+    for s in one_peer_dynamic_schedule(N):
+        out.append(DynamicTopology.from_edges(
+            s.size, {e: 0.3 for e in s.edges}, [0.7] * s.size))
+    return out
+
+
+def _problem():
+    base = {"w1": jnp.asarray(np.random.RandomState(7).randn(4, 4) * 0.3),
+            "b1": jnp.zeros((4,)),
+            "w2": jnp.asarray(np.random.RandomState(8).randn(4, 2) * 0.3),
+            "b2": jnp.zeros((2,))}
+
+    def loss_fn(params, batch):
+        h = jnp.tanh(batch @ params["w1"] + params["b1"])
+        return jnp.mean((h @ params["w2"] + params["b2"]) ** 2)
+
+    return base, loss_fn
+
+
+def _build(monkeypatch, fused, **kwargs):
+    base, loss_fn = _problem()
+    if fused:
+        monkeypatch.delenv("BLUEFOG_FUSE_EPILOGUES", raising=False)
+    else:
+        monkeypatch.setenv("BLUEFOG_FUSE_EPILOGUES", "0")
+    try:
+        step = F.build_train_step(loss_fn, _OPT, _mesh(), donate=False,
+                                  **kwargs)
+    finally:
+        monkeypatch.delenv("BLUEFOG_FUSE_EPILOGUES", raising=False)
+    return step
+
+
+def _state(mesh, push_sum=False):
+    base, _ = _problem()
+    params = F.rank_major(base, mesh)
+    ostate = F.rank_major(_OPT.init(base), mesh)
+    if push_sum:
+        ostate = (ostate, F.push_sum_weights(mesh))
+    return params, ostate
+
+
+def _batch(mesh, s):
+    raw = np.random.RandomState(100 + s).randn(N, 3, 4).astype(np.float32)
+    return jax.device_put(raw, NamedSharding(mesh, P("bf")))
+
+
+def _run(step, mesh, *, guarded, push_sum=False, steps=2):
+    params, ostate = _state(mesh, push_sum=push_sum)
+    skips, hv = None, None
+    for s in range(steps):
+        args = (params, ostate, _batch(mesh, s), jnp.int32(s))
+        if guarded:
+            args = args + (step.default_comm_weights,)
+        out = step(*args)
+        params, ostate, loss = out[0], out[1], out[2]
+        rest = out[3:]
+        if guarded:
+            skips, rest = rest[0], rest[1:]
+        if rest:
+            hv = rest[0]
+    return params, ostate, loss, skips, hv
+
+
+def _matrix():
+    """The guard x health x compress x comm_mode x overlap parity
+    matrix, budgeted for tier-1 wall time (each case is two jit builds
+    on the 8-device mesh):
+
+    * the FULL fp product over (comm_mode, overlap, guard, health) on
+      the static weighted ring — every builder branch combination;
+    * int8 wire with health on (health's consensus term is the one
+      consumer of the dequantized buffers): both modes x both guard
+      values on the bucketed path (per-BUCKET scales + guarded
+      weighted path + key folding — the interactions the refactor
+      touches) plus one plain case (per-TENSOR scales);
+    * push_sum (guard/compress rejected by validation) over
+      (overlap, health);
+    * two lax.switch schedule pins: the plain-atc config that caught
+      apply-inside-switch contraction drift, plus the fully-loaded
+      bucketed case (switch x per-bucket closures).
+    """
+    ring = _weighted_ring()
+    cases = []
+    for comm_mode in ("cta", "atc"):
+        for overlap in ("none", "bucketed"):
+            for guard in (False, True):
+                for health in (False, True):
+                    cases.append(dict(
+                        comm_mode=comm_mode, overlap=overlap,
+                        guard=guard, health=health, compress=None,
+                        topology=ring))
+        for guard in (False, True):
+            cases.append(dict(
+                comm_mode=comm_mode, overlap="bucketed", guard=guard,
+                health=True, compress="int8", topology=ring))
+    cases.append(dict(comm_mode="atc", overlap="none", guard=True,
+                      health=True, compress="int8", topology=ring))
+    for overlap in ("none", "bucketed"):
+        for health in (False, True):
+            cases.append(dict(
+                comm_mode="push_sum", overlap=overlap, guard=False,
+                health=health, compress=None, topology=ring))
+    cases.append(dict(comm_mode="atc", overlap="none", guard=False,
+                      health=False, compress=None,
+                      schedule=_weighted_schedule()))
+    cases.append(dict(comm_mode="atc", overlap="bucketed", guard=True,
+                      health=True, compress=None,
+                      schedule=_weighted_schedule()))
+    return cases
+
+
+def _case_id(c):
+    return "-".join([
+        c["comm_mode"], c["overlap"],
+        "guard" if c["guard"] else "noguard",
+        "health" if c["health"] else "nohealth",
+        c["compress"] or "fp",
+        "sched" if "schedule" in c else "static"])
+
+
+@pytest.mark.perf
+@pytest.mark.parametrize("case", _matrix(), ids=_case_id)
+def test_fused_matches_unfused_reference(case, monkeypatch):
+    """The fused pipeline reproduces the pre-fusion reference path:
+    bit-identical params/opt_state/loss/skip flags at every matrix
+    point, HealthVector within f32 tolerance (bitwise too on the
+    plain path)."""
+    mesh = _mesh()
+    case = dict(case)
+    guarded = case.pop("guard")
+    health = case.pop("health")
+    push_sum = case["comm_mode"] == "push_sum"
+    kwargs = dict(case)
+    if kwargs["overlap"] == "none":
+        kwargs.pop("overlap")
+    else:
+        kwargs["overlap_buckets"] = 3
+    if kwargs.get("compress") is None:
+        kwargs.pop("compress")
+    if guarded:
+        kwargs["guard"] = F.GuardConfig()
+    if health:
+        kwargs["health"] = F.HealthConfig()
+
+    fused = _build(monkeypatch, True, **kwargs)
+    if push_sum and case["overlap"] == "bucketed":
+        # no unfused reference exists (the pre-fusion builder rejects
+        # it) — pin against the fused PLAIN path instead, which the
+        # rest of the matrix anchors to the reference: bucketing is an
+        # exact rewrite of the push-sum mix (elementwise-linear)
+        ref_kwargs = dict(kwargs)
+        ref_kwargs.pop("overlap")
+        ref_kwargs.pop("overlap_buckets")
+        ref = _build(monkeypatch, True, **ref_kwargs)
+    else:
+        ref = _build(monkeypatch, False, **kwargs)
+
+    pf, of, lf, sf, hf = _run(fused, mesh, guarded=guarded,
+                              push_sum=push_sum)
+    pr, orr, lr, sr, hr = _run(ref, mesh, guarded=guarded,
+                               push_sum=push_sum)
+    np.testing.assert_array_equal(np.asarray(lf), np.asarray(lr))
+    for a, b in zip(jax.tree.leaves((pf, of)), jax.tree.leaves((pr, orr))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    if guarded:
+        np.testing.assert_array_equal(np.asarray(sf), np.asarray(sr))
+    if health:
+        assert isinstance(hf, F.HealthVector)
+        for name, a, b in zip(hf._fields, hf, hr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7,
+                err_msg=f"HealthVector.{name}")
+
+
+def test_uniform_static_cta_guarded_bit_identical(monkeypatch):
+    """The converted PR-3 caveat: uniform-weight static CTA was the one
+    config where guarded != unguarded bitwise (the unfused builder's
+    constant weights let XLA fold the combine into (sum)*w, which
+    traced weight operands cannot legally reproduce — this very test
+    FAILS under BLUEFOG_FUSE_EPILOGUES=0, reproducing the caveat).
+    The fused pipeline feeds BOTH builds the same traced-weight
+    combine, so the association orders agree and the caveat is gone."""
+    mesh = _mesh()
+    spec = uniform_topology_spec(ExponentialTwoGraph(N))
+    kwargs = dict(comm_mode="cta", topology=spec)
+    step_u = _build(monkeypatch, True, **kwargs)
+    step_g = _build(monkeypatch, True, guard=F.GuardConfig(), **kwargs)
+    params, ostate = _state(mesh)
+    p2, o2 = params, ostate
+    for s in range(5):
+        batch = _batch(mesh, s)
+        params, ostate, loss = step_u(params, ostate, batch, jnp.int32(s))
+        p2, o2, loss2, skipped = step_g(p2, o2, batch, jnp.int32(s),
+                                        step_g.default_comm_weights)
+        np.testing.assert_array_equal(np.asarray(skipped),
+                                      np.zeros(N, np.int32))
+    for a, b in zip(jax.tree.leaves((params, ostate, loss)),
+                    jax.tree.leaves((p2, o2, loss2))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_push_sum_bucketed_converges_and_keeps_invariant():
+    """overlap='bucketed' now rides the push-sum exchange: the mixed
+    ps-weights keep sum == n and the trajectory matches the plain
+    push-sum step bitwise (bucketing distributes over the
+    column-stochastic mix)."""
+    mesh = _mesh()
+    base, loss_fn = _problem()
+    spec = _weighted_ring()
+    plain = F.build_train_step(loss_fn, _OPT, mesh, donate=False,
+                               comm_mode="push_sum", topology=spec)
+    bucketed = F.build_train_step(loss_fn, _OPT, mesh, donate=False,
+                                  comm_mode="push_sum", topology=spec,
+                                  overlap="bucketed", overlap_buckets=2)
+    pA, oA = _state(mesh, push_sum=True)
+    pB, oB = pA, oA
+    for s in range(6):
+        batch = _batch(mesh, s)
+        pA, oA, lA = plain(pA, oA, batch, jnp.int32(s))
+        pB, oB, lB = bucketed(pB, oB, batch, jnp.int32(s))
+    np.testing.assert_allclose(np.sum(np.asarray(oA[1])), N, rtol=1e-6)
+    np.testing.assert_allclose(np.sum(np.asarray(oB[1])), N, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(lA), np.asarray(lB),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(pA), jax.tree.leaves(pB)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-8)
+
+
+def test_epilogue_plan_carries_stage_lists():
+    """EpiloguePlan buckets carry their stage lists in canonical
+    order, and build_train_step exposes the composed stages."""
+    leaves = [jnp.zeros((16, 16)), jnp.zeros((16,)),
+              jnp.zeros((16, 4)), jnp.zeros((4,))]
+    plan = fusion.EpiloguePlan.for_leaves(
+        leaves, 2, compress="int8", guard=True, health=True,
+        consensus=True)
+    assert plan.stages == ("pack", "quantize", "exchange", "dequantize",
+                           "guard_select", "health_norm", "consensus",
+                           "unpack")
+    assert all(b.stages == plan.stages for b in plan.buckets)
+    # buckets partition the leaves in tree order
+    flat = [i for b in plan.buckets for i in b.leaves]
+    assert flat == list(range(len(leaves)))
+    # plain path: one bucket per leaf
+    plain = fusion.EpiloguePlan.for_leaves(leaves, None)
+    assert [list(b.leaves) for b in plain.buckets] == [[0], [1], [2], [3]]
+    assert plain.stages == ("pack", "exchange", "unpack")
+    # the eager FusionPlan's buckets carry stage lists too
+    fp = fusion.FusionPlan.for_leaves(
+        [jnp.zeros((N, 8)), jnp.zeros((N, 8))], threshold=1 << 20)
+    assert all(b.stages == ("pack", "exchange", "unpack")
+               for b in fp.buckets)
+
+    mesh = _mesh()
+    base, loss_fn = _problem()
+    step = F.build_train_step(
+        loss_fn, _OPT, mesh, comm_mode="atc", donate=False,
+        topology=_weighted_ring(), compress="int8",
+        health=F.HealthConfig(), overlap="bucketed", overlap_buckets=2)
+    assert step.epilogue_stages == (
+        "pack", "quantize", "exchange", "dequantize", "health_norm",
+        "consensus", "unpack")

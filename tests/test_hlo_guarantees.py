@@ -26,6 +26,10 @@ from bluefog_tpu.topology import graphs
 from bluefog_tpu.topology.dynamic import one_peer_dynamic_schedule
 from bluefog_tpu.topology.spec import uniform_topology_spec
 
+# every test here is a machine-checked performance guarantee on the
+# compiled HLO (the 8B audit below additionally carries `slow`)
+pytestmark = pytest.mark.perf
+
 N = 8
 
 
@@ -375,6 +379,69 @@ def test_overlap_accounting_dataflow_basis_on_real_step(mesh):
     assert none["fraction"] == 0.0
 
 
+def _feature_step(mesh, comm_mode, fused):
+    """The ISSUE-6 audit config: guard + health + int8 wire + bucketed
+    overlap — the feature stack whose separate tree-walks the fused
+    epilogue pipeline replaces."""
+    import os
+
+    import optax as ox
+
+    base, loss_fn = _overlap_problem()
+    spec = one_peer_dynamic_schedule(N)[0]
+    kw = dict(comm_mode=comm_mode, topology=spec, overlap="bucketed",
+              overlap_buckets=4, compress="int8", donate=False,
+              guard=F.GuardConfig(), health=F.HealthConfig())
+    # pin the requested pipeline explicitly (and restore the ambient
+    # setting): honoring an exported BLUEFOG_FUSE_EPILOGUES=0 on the
+    # fused leg would make this guarantee vacuously compare
+    # unfused-vs-unfused
+    prior = os.environ.get("BLUEFOG_FUSE_EPILOGUES")
+    os.environ["BLUEFOG_FUSE_EPILOGUES"] = "1" if fused else "0"
+    try:
+        step = F.build_train_step(loss_fn, ox.sgd(0.05), mesh, **kw)
+    finally:
+        if prior is None:
+            os.environ.pop("BLUEFOG_FUSE_EPILOGUES", None)
+        else:
+            os.environ["BLUEFOG_FUSE_EPILOGUES"] = prior
+    opt = ox.sgd(0.05)
+    params = F.rank_major(base, mesh)
+    ostate = F.rank_major(opt.init(base), mesh)
+    batch = jax.device_put(
+        np.zeros((N, 8, 16)), NamedSharding(mesh, P("bf")))
+    return step, (params, ostate, batch, jnp.int32(0),
+                  step.default_comm_weights)
+
+
+@pytest.mark.parametrize("comm_mode", ["cta", "atc"])
+def test_fused_epilogue_no_extra_noncollective_ops(mesh, comm_mode):
+    """ISSUE 6 acceptance: at the full feature config (guard + health +
+    int8 wire + bucketed overlap) the fused per-bucket epilogue
+    pipeline compiles to NO MORE non-collective HLO ops than the
+    pre-fusion tree-walk builders — the guard's isfinite reduce, the
+    health norms, and the consensus distance ride the per-bucket pass
+    instead of re-traversing the tree — while the collective schedule
+    itself (op count AND payload bytes) is unchanged.  Measured through
+    ``observe.stepprof.profile_step``, the same per-op breakdown the
+    benchmarks ship."""
+    from bluefog_tpu.observe import stepprof
+
+    fused_step, args = _feature_step(mesh, comm_mode, fused=True)
+    unfused_step, uargs = _feature_step(mesh, comm_mode, fused=False)
+    pf = stepprof.profile_step(fused_step, *args, name="fused",
+                               publish=False)
+    pu = stepprof.profile_step(unfused_step, *uargs, name="unfused",
+                               publish=False)
+    # identical wire schedule: same collective count and payload bytes
+    assert pf.collective_bytes == pu.collective_bytes
+    # and the non-collective program shrank (or at worst broke even)
+    assert pf.non_collective_ops() <= pu.non_collective_ops(), (
+        pf.non_collective_ops(), pu.non_collective_ops())
+    # the estimator's non-collective flops must not regress either
+    assert pf.non_collective_flops() <= pu.non_collective_flops() * 1.001
+
+
 @pytest.mark.slow
 def test_8b_overlap_audit_end_to_end(tmp_path):
     """The full 8B overlap audit (benchmarks/llama_8b_overlap.py): AOT
@@ -399,6 +466,11 @@ def test_8b_overlap_audit_end_to_end(tmp_path):
     got = json.loads(out.read_text())
     assert 0.0 <= got["overlap"]["dp_neighbor_exchange"]["fraction"] <= 1.0
     assert got["overlap"]["buckets"] >= 1
+    # ISSUE 6: the fused epilogue accounting rides the audit — fewer
+    # non-collective ops at an unchanged collective schedule
+    claims = got["epilogue"]["claims"]
+    assert claims["fused_ops_leq_unfused"] is True
+    assert claims["collective_schedule_unchanged"] is True
 
 
 def test_hlo_collective_bytes_extraction(mesh):

@@ -317,6 +317,47 @@ def test_profile_step_matches_benchutil_primitives():
     assert d["flops"] == prof.flops and "mfu" in d
 
 
+def test_profile_step_caches_hlo_analysis_per_executable():
+    """ISSUE 6 satellite: repeat profile_step calls on the SAME
+    compiled step hit the per-module analysis cache (XLA cost analysis
+    + per-op parse run once); a different program misses.  The cached
+    artifacts are identical objects across calls."""
+    from bluefog_tpu.observe import stepprof
+
+    mesh = Mesh(np.array(jax.devices()[:N]), ("bf",))
+
+    def f(x):
+        return jax.lax.psum(x @ x, "bf")
+
+    def g(x):
+        return jax.lax.psum(x + x, "bf")
+
+    sm_f = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("bf"),
+                                 out_specs=P(), check_vma=False))
+    sm_g = jax.jit(jax.shard_map(g, mesh=mesh, in_specs=P("bf"),
+                                 out_specs=P(), check_vma=False))
+    x = jnp.ones((N, 16, 16), jnp.float32)
+    stepprof.profile_cache_clear()
+    p1 = profile_step(sm_f, x, name="a", publish=False)
+    info = stepprof.profile_cache_info()
+    assert info["misses"] == 1 and info["hits"] == 0
+    p2 = profile_step(sm_f, x, name="b", step_seconds=0.5,
+                      publish=False)
+    info = stepprof.profile_cache_info()
+    assert info["hits"] == 1 and info["misses"] == 1
+    # cached parse is shared, not re-derived
+    assert p2.op_breakdown is p1.op_breakdown
+    assert p2.collective_bytes is p1.collective_bytes
+    assert p2.flops == p1.flops
+    # a different executable is a miss
+    profile_step(sm_g, x, name="c", publish=False)
+    info = stepprof.profile_cache_info()
+    assert info["misses"] == 2 and info["entries"] == 2
+    stepprof.profile_cache_clear()
+    assert stepprof.profile_cache_info() == {
+        "hits": 0, "misses": 0, "entries": 0}
+
+
 def _bucketed_step(mesh, K=4):
     from bluefog_tpu.optim import functional as F
     from bluefog_tpu.topology.dynamic import one_peer_dynamic_schedule

@@ -234,16 +234,21 @@ def test_heal_weights_rejects_bad_mask():
 def test_guard_no_faults_bit_identical():
     """Acceptance (a): faults absent, the guarded step IS the unguarded
     step — bit-identical params/opt_state/loss across a multi-step
-    trajectory, for both a static topology (atc) and the lax.switch
-    dynamic schedule (cta).  (Uniform-weight static CTA is excluded by
-    design: XLA constant-folds the uniform weight vector to a scalar
-    and factors the combine into (sum)*w, a 1-ulp rewrite traced weight
-    operands cannot legally reproduce.)"""
+    trajectory, for a static topology (atc), the lax.switch dynamic
+    schedule (cta), AND uniform-weight static CTA.  The last config was
+    excluded by design before ISSUE 6 (the unguarded builder baked the
+    uniform weight vector as a constant that XLA folded into (sum)*w,
+    a 1-ulp rewrite traced weight operands cannot legally reproduce);
+    the fused epilogue pipeline feeds BOTH builds the same traced-
+    weight combine, so the association orders agree everywhere
+    (tests/test_epilogue.py pins the same guarantee)."""
     mesh = _mesh()
     configs = [
         dict(comm_mode="atc",
              topology=uniform_topology_spec(ExponentialTwoGraph(N))),
         dict(comm_mode="cta", schedule=one_peer_dynamic_schedule(N)),
+        dict(comm_mode="cta",
+             topology=uniform_topology_spec(ExponentialTwoGraph(N))),
     ]
     for cfg in configs:
         step_u = F.build_train_step(_loss_fn, _OPT, mesh, donate=False,
