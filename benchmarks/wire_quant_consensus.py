@@ -27,11 +27,25 @@ over the exact north-star schedules:
 
 Reported per config: consensus error (max |x - x_bar|, x_bar the running
 mean) and mean drift (|x_bar - x_bar_0|) at checkpoints, plus the floor
-(median consensus error over the last 20% of rounds).  The claim under
+(median consensus error over the last 20% of rounds).  The claims under
 test: both rounding modes keep a BOUNDED floor at n=128 on every
 north-star schedule (no growth with rounds), and stochastic rounding's
-floor is no worse — with its mean drift growing strictly slower (random
-walk vs accumulation).
+floor is no worse.
+
+Round 12 (VERDICT item 6) adds the DRIFT side of the trade, previously
+unchecked: SR's unbiased per-entry noise random-walks the GLOBAL mean
+(every round injects zero-mean noise into x_bar, which nothing pulls
+back), so on the exact-average exp2 schedules — where RTN's bias has
+the least room to accumulate — SR's drift ends ~2x RTN's (r05: 0.00426
+vs 0.00208 on torus_exp2) even while its consensus floor is the better
+one.  On slow-mixing single-hop the picture inverts (RTN's bias gets
+~712 rounds per consensus to compound, and SR drifts LESS); the
+per-schedule ``sr_drift_vs_rtn`` ratio records whichever way the trade
+lands.  The ``drift_bounded`` checks certify both modes' walk stays
+inside ONE int8 grid step of the initial payload over the full
+2100-round horizon — bounded in practice, not just
+bounded-in-expectation.  (Error feedback would bound both tighter;
+until then the trade is measured and documented, not hidden.)
 
 Run (CPU, no TPU, pure numpy): python benchmarks/wire_quant_consensus.py
 """
@@ -94,7 +108,7 @@ def main():
                     help="~3x single-hop's 712-round consensus horizon")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out",
-                    default="benchmarks/wire_quant_consensus_r05.json")
+                    default="benchmarks/wire_quant_consensus_r12.json")
     args = ap.parse_args()
 
     rng = np.random.default_rng(args.seed)
@@ -145,6 +159,16 @@ def main():
         checks[f"{sname}_sr_floor_le_rtn"] = (
             sr["consensus_floor_median_tail"]
             <= rtn["consensus_floor_median_tail"] * 1.25)
+        # (3) VERDICT item 6: the DRIFT of the global mean is bounded
+        # too — within one int8 grid step over the full horizon — for
+        # both rounding modes.  RTN's drift is a biased accumulation,
+        # SR's a random walk (unbiased per entry, but nothing restores
+        # the mean); which is worse depends on the schedule (SR ~2x on
+        # exp2, RTN worse on single-hop) — the ratio records it.
+        checks[f"{sname}_rtn_drift_bounded"] = rtn["drift_final"] < grid
+        checks[f"{sname}_sr_drift_bounded"] = sr["drift_final"] < grid
+        results[f"{sname}_sr"]["sr_drift_vs_rtn"] = (
+            sr["drift_final"] / max(rtn["drift_final"], 1e-300))
     for k, ok in checks.items():
         print(f"[check] {k}: {'OK' if ok else 'FAILED'}")
 
@@ -155,6 +179,15 @@ def main():
                      "collectives._wire_quantize_int8); rtn = "
                      "round-to-nearest (the deterministic default), "
                      "sr = stochastic rounding (compress='int8_sr')",
+        "drift_note": "drift = |mean(x) - mean(x0)|; the "
+                      "drift_bounded checks certify both rounding "
+                      "modes stay within one int8 grid step over the "
+                      "horizon, and the per-schedule sr_drift_vs_rtn "
+                      "ratio records the floor-vs-drift trade: on the "
+                      "exact-average exp2 schedules SR buys its "
+                      "better floor with ~2x RTN's drift; on "
+                      "slow-mixing single-hop RTN's bias compounds "
+                      "and SR drifts less",
         "results": results,
         "checks": {k: bool(v) for k, v in checks.items()},
     }

@@ -679,9 +679,10 @@ def fwd_bwd_time(f, params, x0, n=20, reps=3):
 # --------------------------------------------------------------------- #
 # headline fields worth gating, with their GOOD direction
 _HEADLINE_HIGHER = ("value", "mfu", "tokens_per_sec", "useful_tokens",
-                    "speedup_tokens_per_sec", "vs_baseline")
+                    "speedup_tokens_per_sec", "vs_baseline",
+                    "compiled_advantage")
 _HEADLINE_LOWER = ("ttft_p50", "ttft_p99", "latency_p50", "latency_p99",
-                   "makespan_s", "p99", "p50")
+                   "makespan_s", "p99", "p50", "cost_to_consensus")
 
 
 def bench_headline(record: dict) -> dict:
@@ -703,7 +704,8 @@ def bench_headline(record: dict) -> dict:
                 out[prefix + k] = float(v)
 
     grab(record, "")
-    for section in ("continuous", "static", "chaos", "straggler"):
+    for section in ("continuous", "static", "chaos", "straggler",
+                    "pod_4x8", "pod_8x16"):
         if isinstance(record.get(section), dict):
             grab(record[section], section + ".")
     return out

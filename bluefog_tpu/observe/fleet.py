@@ -57,6 +57,7 @@ __all__ = [
     "edge_list",
     "gossip_edge_list",
     "record_edge_traffic",
+    "traffic_snapshot",
     "push_sum_matrix",
     "FleetAggregate",
     "FleetAggregator",
@@ -101,6 +102,31 @@ def record_edge_traffic(spec: CommSpec, payload_bytes: float,
     for (src, dst) in (edge_list(spec) if pairs is None else pairs):
         reg.counter("bf_edge_bytes_total", _EDGE_BYTES_HELP,
                     src=src, dst=dst).inc(payload_bytes)
+
+
+def traffic_snapshot(registry=None) -> Dict[tuple, float]:
+    """The accumulated per-edge exchange traffic, read back OUT of the
+    registry: ``{(src, dst): bytes}`` from every
+    ``bf_edge_bytes_total{src,dst}`` counter — the feed the topology
+    compiler's :meth:`~bluefog_tpu.topology.compiler.PodSpec.calibrated`
+    cost model consumes, so synthesized schedules adapt to the link
+    traffic the fleet actually measured (train-step exchanges + gossip
+    wire cost, everything :func:`record_edge_traffic` billed).  Empty
+    when observability is off or nothing was recorded."""
+    reg = registry if registry is not None else (
+        _registry_mod.get_registry() if _registry_mod.enabled() else None)
+    if reg is None:
+        return {}
+    out: Dict[tuple, float] = {}
+    for name, kind, _help, labels, m in reg.collect():
+        if name != "bf_edge_bytes_total" or kind != "counter":
+            continue
+        try:
+            key = (int(labels["src"]), int(labels["dst"]))
+        except (KeyError, ValueError):
+            continue
+        out[key] = out.get(key, 0.0) + float(m.value)
+    return out
 
 
 def push_sum_matrix(spec: CommSpec, dead_mask=None) -> np.ndarray:

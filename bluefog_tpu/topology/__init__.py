@@ -48,7 +48,15 @@ from bluefog_tpu.topology.torus import (  # noqa: F401
     round_congestion,
     schedule_congestion,
     consensus_contraction,
+    rounds_from_contraction,
     rounds_to_consensus,
     score_schedule,
     default_pod_schedule,
+)
+from bluefog_tpu.topology.compiler import (  # noqa: F401
+    PodSpec,
+    Sketch,
+    CompiledTopology,
+    compile_topology,
+    menu_schedules,
 )

@@ -204,6 +204,18 @@ class DynamicTopology:
         ew = dict(zip(self.edges, self.edge_weight_values))
         return _decompose(self.size, self.edges, ew)
 
+    def in_degrees(self) -> np.ndarray:
+        """Per-rank in-degree (received edges) of this round — the
+        quantity the topology compiler's sketch bounds (``max_degree``):
+        one-peer rounds are 1 everywhere, multi-shift rounds higher."""
+        deg = np.zeros(self.size, np.int64)
+        for (_, dst) in self.edges:
+            deg[dst] += 1
+        return deg
+
+    def max_in_degree(self) -> int:
+        return int(self.in_degrees().max()) if self.edges else 0
+
     def digest(self) -> str:
         h = hashlib.sha1(repr((self.size, self.edges, self.edge_weight_values,
                                self.self_weight_values)).encode())

@@ -52,6 +52,7 @@ __all__ = [
     "torus_shift_round",
     "mixing_matrix",
     "consensus_contraction",
+    "rounds_from_contraction",
     "rounds_to_consensus",
     "score_schedule",
     "default_pod_schedule",
@@ -109,14 +110,20 @@ def _axis_route(delta: int, length: int) -> List[Tuple[int, int, float]]:
 
 
 def link_loads(
-    send_map: Dict[int, int],
+    send_map,
     spec: TorusSpec,
     embedding: Optional[Sequence[int]] = None,
+    payloads: Optional[Dict[Tuple[int, int], float]] = None,
 ) -> Dict[Tuple[Tuple[int, ...], int, int], float]:
-    """Per-directed-link payload load of one permutation round under
+    """Per-directed-link payload load of one exchange round under
     dimension-ordered minimal routing.
 
-    ``send_map``: {src_rank: dst_rank}, each src sending one full payload.
+    ``send_map``: {src_rank: dst_rank} (one-peer rounds), or an iterable
+    of ``(src, dst)`` pairs — the multi-shift form, where one src may
+    send to several dsts in the same round (in-degree > 1 schedules;
+    duplicate pairs accumulate).  Each pair routes one payload unless
+    ``payloads[(src, dst)]`` scales it (the traffic-calibration path
+    routes measured per-edge BYTES instead of unit payloads).
     ``embedding``: optional permutation; ``embedding[r]`` is the torus
     position of logical rank r (identity = row-major, the
     ``create_device_mesh`` order).  A link is keyed
@@ -125,8 +132,14 @@ def link_loads(
     """
     loads: Dict[Tuple[Tuple[int, ...], int, int], float] = {}
     emb = list(range(spec.size)) if embedding is None else list(embedding)
-    for src, dst in send_map.items():
+    pairs = (send_map.items() if isinstance(send_map, dict)
+             else list(send_map))
+    for src, dst in pairs:
         if src == dst:
+            continue
+        size = 1.0 if payloads is None else float(
+            payloads.get((src, dst), 1.0))
+        if size == 0.0:
             continue
         cur = list(spec.coord(emb[src]))
         tgt = spec.coord(emb[dst])
@@ -140,7 +153,7 @@ def link_loads(
                 for _ in range(hops):
                     cur[ax] = pos
                     key = (tuple(cur), ax, sign)
-                    loads[key] = loads.get(key, 0.0) + frac
+                    loads[key] = loads.get(key, 0.0) + frac * size
                     pos = (pos + sign) % L
             cur[ax] = tgt[ax]
     return loads
@@ -153,11 +166,14 @@ def round_congestion(
 ) -> float:
     """Maximum per-link load of one round (1.0 == a single payload at full
     link rate; the round's wall-time multiplier under the pessimistic,
-    link-limited model)."""
+    link-limited model).  Multi-shift ``DynamicTopology`` rounds
+    (in-degree > 1) route EVERY declared edge — the loads add."""
     if isinstance(round_or_map, DynamicTopology):
-        send_map = {src: dst for (src, dst) in round_or_map.edges}
-    else:
+        send_map = list(round_or_map.edges)
+    elif isinstance(round_or_map, dict):
         send_map = dict(round_or_map)
+    else:
+        send_map = list(round_or_map)
     loads = link_loads(send_map, spec, embedding)
     return max(loads.values()) if loads else 0.0
 
@@ -252,13 +268,20 @@ def consensus_contraction(schedule: Sequence[DynamicTopology]) -> float:
     return float(np.max(np.abs(np.linalg.eigvals(dev))))
 
 
-def _r2c_from_sigma(sigma: float, period: int, eps: float) -> float:
-    """Rounds to eps-consensus given one period's contraction sigma."""
+def rounds_from_contraction(sigma: float, period: int,
+                            eps: float = 1e-3) -> float:
+    """Rounds to eps-consensus given one period's contraction sigma —
+    the closed-form core of :func:`rounds_to_consensus`, public so the
+    topology compiler's Fourier-scored candidates (which know sigma
+    without building matrices) share the exact same figure of merit."""
     if sigma <= eps:  # exact (or better than eps) within one period
         return float(period)
     if sigma >= 1.0:
         return float("inf")
     return float(period * math.log(eps) / math.log(sigma))
+
+
+_r2c_from_sigma = rounds_from_contraction  # internal alias (pre-PR name)
 
 
 def rounds_to_consensus(
@@ -299,6 +322,12 @@ def default_pod_schedule(
 ):
     """The documented default one-peer schedule for a pod's physical torus
     ``axes`` — picked by MACHINE-COUNTED score, not by rule of thumb.
+
+    This two-entry menu is the floor, not the ceiling: for a real pod
+    (heterogeneous DCN/ICI links, measured traffic) use
+    ``topology.compiler.compile_topology``, which SEARCHES the weighted
+    multi-shift schedule space and beats both menu entries at pod
+    shapes (docs/topology.md).
 
     Candidates (all defined in torus coordinates, so every round's link
     congestion is exact, not a 1-D hop guess):

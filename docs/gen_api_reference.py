@@ -31,7 +31,15 @@ MODULES = [
     ("bluefog_tpu", "top-level package: init/size/rank + the full op API"),
     ("bluefog_tpu.api", "the flat op API (collectives, windows, timeline)"),
     ("bluefog_tpu.topology", "graph generators, weights, dynamic iterators"),
+    ("bluefog_tpu.topology.graphs",
+     "static graph generators (exp2, ring, mesh, star) + weights"),
+    ("bluefog_tpu.topology.dynamic",
+     "dynamic one-peer schedules: world-level rounds + iterators"),
+    ("bluefog_tpu.topology.spec",
+     "device-ready Topology/DynamicTopology shift-class specs"),
     ("bluefog_tpu.topology.torus", "physical ICI torus routing/congestion"),
+    ("bluefog_tpu.topology.compiler",
+     "topology compiler: pod cost model + schedule synthesis"),
     ("bluefog_tpu.optim", "distributed optimizer wrappers (eager API)"),
     ("bluefog_tpu.optim.functional",
      "jitted whole-pytree train steps (SPMD API)"),
