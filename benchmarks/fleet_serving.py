@@ -1,0 +1,432 @@
+"""Fleet serving: replicated routing, prefix reuse, speculative decode.
+
+The machine-checked acceptance artifact of the fleet serving subsystem
+(ISSUE 9).  Four experiments over one seeded Poisson trace
+(``benchutil.poisson_arrivals`` — the generator the serving tests and
+``serving_bench.py`` replay):
+
+* **fleet_one / fleet_two** — the same trace served by a 1-replica and
+  a 2-replica :class:`~bluefog_tpu.serving.FleetRouter` fleet.  Each
+  replica models its OWN accelerator: the per-step device cost is
+  measured on the real engine once (median of timed steps on this
+  host), then the fleet dynamics run in lockstep VIRTUAL time — every
+  busy replica steps concurrently per tick, exactly as a pod of
+  single-chip replicas would.  (The same style of measured-cost
+  simulation as ``topology_compiler.py``'s pod cost model; a
+  single-core CI host cannot exhibit replica parallelism natively, and
+  wall-clock thread timing would gate on scheduler noise rather than
+  the subsystem.)  Routing decisions are REAL: every admission gossips
+  the replicas' occupancy/queue/TTFT gauges by push-sum and walks the
+  router's converged preference order.
+* **prefix** — one prefix-cached engine, real wall time: requests
+  sharing a long prompt prefix admit warm (cached chunks restored by
+  copy) vs cold (full chunked prefill), TTFT measured per admission,
+  outputs compared bit-exactly against a prefix-cache-free engine.
+* **speculative** — the draft/verify resident pair at temperature 0
+  with the target as its own draft (acceptance is then structural:
+  every window verifies, so each step emits ``lookahead+1`` tokens),
+  outputs compared bit-exactly against the plain engine.
+* **resident** — the resident-program contract: the build-time
+  registry is FIXED (2 programs plain, 3 speculative), serving load
+  adds no entries, and ``profile()`` enumerates exactly that set.
+
+``machine_checked`` in the emitted record carries the pass/fail of
+each claim; any failure exits 1.  Gates against the committed
+``benchmarks/fleet_serving_baseline.json`` by default (``--compare ''``
+to disable).
+
+  JAX_PLATFORMS=cpu python benchmarks/fleet_serving.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bluefog_tpu import models
+from bluefog_tpu.benchutil import poisson_arrivals
+from bluefog_tpu.observe.registry import MetricsRegistry
+from bluefog_tpu.serving import (FleetRouter, Request, ServingEngine,
+                                 SpeculativeConfig, percentile)
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "fleet_serving_baseline.json")
+
+parser = argparse.ArgumentParser(description=__doc__)
+parser.add_argument("--num-requests", type=int, default=24)
+parser.add_argument("--arrivals-per-step", type=float, default=1.5,
+                    help="mean Poisson arrivals per engine step of "
+                         "virtual time; >1 saturates one replica.  "
+                         "Arrival times scale with the measured step "
+                         "cost, so the fleet dynamics (and every "
+                         "virtual-time metric in units of step cost) "
+                         "are deterministic for a given seed")
+parser.add_argument("--capacity", type=int, default=3)
+parser.add_argument("--max-len", type=int, default=96)
+parser.add_argument("--prefill-chunk", type=int, default=8)
+parser.add_argument("--prompt-len", type=int, nargs=2, default=(4, 24))
+parser.add_argument("--new-tokens", type=int, nargs=2, default=(6, 16))
+parser.add_argument("--lookahead", type=int, default=3)
+parser.add_argument("--prefix-pairs", type=int, default=4,
+                    help="cold/warm admission pairs in the prefix "
+                         "experiment")
+parser.add_argument("--prefix-len", type=int, default=64,
+                    help="shared-prefix length (a multiple of "
+                         "--prefill-chunk reuses every chunk)")
+parser.add_argument("--seed", type=int, default=0)
+parser.add_argument("--dim", type=int, default=128)
+parser.add_argument("--layers", type=int, default=4)
+parser.add_argument("--out", default="fleet_serving_r09.json")
+parser.add_argument("--compare", metavar="PREV.json",
+                    default=(DEFAULT_BASELINE
+                             if os.path.exists(DEFAULT_BASELINE)
+                             else None),
+                    help="regression gate (default: the committed "
+                         "benchmarks/fleet_serving_baseline.json when "
+                         "present; pass '' to disable)")
+parser.add_argument("--tolerance", type=float, default=0.25,
+                    help="gate tolerance (loose: the virtual-time "
+                         "numbers scale with this host's measured "
+                         "step cost)")
+
+
+def parse_args(argv=None):
+    args = parser.parse_args(argv)
+    if args.compare == "":
+        args.compare = None
+    return args
+
+
+class _Clock:
+    """The fleet simulation's shared virtual clock (injected into every
+    replica, so TTFT/latency percentiles come out of the engines' own
+    metrics in virtual seconds)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_trace(args):
+    rs = np.random.RandomState(args.seed + 1)
+    # unit-rate arrivals; main() rescales them to the measured step
+    # cost (see --arrivals-per-step)
+    arrivals = poisson_arrivals(1.0, args.num_requests, args.seed)
+    lens = rs.randint(args.prompt_len[0], args.prompt_len[1] + 1,
+                      args.num_requests)
+    budgets = rs.randint(args.new_tokens[0], args.new_tokens[1] + 1,
+                         args.num_requests)
+    prompts = [rs.randint(0, 256, (n,)).astype(np.int32) for n in lens]
+    return arrivals, prompts, budgets
+
+
+def measure_step_cost(variables, cfg, args):
+    """Median wall cost of one real engine step under full slots — the
+    per-tick device cost every simulated replica pays."""
+    eng = ServingEngine(variables, cfg, capacity=args.capacity,
+                        max_len=args.max_len,
+                        prefill_chunk=args.prefill_chunk,
+                        registry=MetricsRegistry())
+    rs = np.random.RandomState(args.seed + 2)
+    for _ in range(args.capacity):
+        eng.submit(Request(
+            rs.randint(0, 256, (args.prompt_len[1],)).astype(np.int32),
+            args.new_tokens[1]))
+    eng.step()  # warm the resident programs (admission + first decode)
+    times = []
+    while True:
+        t0 = time.perf_counter()
+        busy = eng.step()
+        times.append(time.perf_counter() - t0)
+        if not busy:
+            break
+    return float(np.median(times))
+
+
+def run_fleet(variables, cfg, args, n_replicas, trace, step_cost):
+    """Serve the trace on ``n_replicas`` simulated single-chip replicas
+    in lockstep virtual time; every admission routes through the real
+    gossip-fed router."""
+    arrivals, prompts, budgets = trace
+    clock = _Clock()
+    regs = [MetricsRegistry() for _ in range(n_replicas)]
+    engines = [ServingEngine(variables, cfg, capacity=args.capacity,
+                             max_len=args.max_len,
+                             prefill_chunk=args.prefill_chunk,
+                             max_queue=args.num_requests,
+                             clock=clock, registry=regs[i])
+               for i in range(n_replicas)]
+    router = FleetRouter(engines, registries=regs)
+    reqs = [Request(p, int(b)) for p, b in zip(prompts, budgets)]
+    pending = list(range(len(reqs)))
+    routed_to = {}
+    finish_vt = {}
+    gossip_rounds = []
+    while not all(r.done for r in reqs):
+        while pending and arrivals[pending[0]] <= clock.t:
+            i = pending.pop(0)
+            snap = router.poll()
+            gossip_rounds.append(snap.rounds)
+            idx, _ = router.submit(reqs[i], snapshot=snap)
+            routed_to[reqs[i].rid] = idx
+        # every busy replica steps CONCURRENTLY (one accelerator each);
+        # the tick costs one measured step regardless of replica count
+        busy = False
+        for e in engines:
+            busy = e.step() or busy
+        for i, r in enumerate(reqs):
+            if r.done and i not in finish_vt:
+                finish_vt[i] = clock.t + step_cost
+        clock.t += step_cost
+        if not busy:
+            if not pending:
+                break
+            clock.t = max(clock.t, arrivals[pending[0]])
+    assert all(r.done for r in reqs)
+    makespan = max(finish_vt.values())
+    useful = sum(len(r.tokens) for r in reqs)
+    ttft = [t for reg_eng in engines for t in reg_eng.metrics.ttfts()]
+    counts = [sum(1 for v in routed_to.values() if v == i)
+              for i in range(n_replicas)]
+    return {
+        "n_replicas": n_replicas,
+        "step_cost_s": step_cost,
+        "tokens_per_sec": useful / makespan,
+        "useful_tokens": int(useful),
+        "makespan_s": makespan,
+        "ttft_p50": percentile(ttft, 50),
+        "ttft_p99": percentile(ttft, 99),
+        "requests_per_replica": counts,
+        "mean_gossip_rounds": float(np.mean(gossip_rounds)),
+        "router": router.summary(),
+    }
+
+
+def run_prefix(variables, cfg, args):
+    """Real-wall-time warm vs cold admission TTFT on one prefix-cached
+    engine, plus bitwise exactness against a cacheless engine."""
+    rs = np.random.RandomState(args.seed + 3)
+    max_len = args.prefix_len + args.prefill_chunk + 16
+    max_len += (-max_len) % args.prefill_chunk
+    eng = ServingEngine(variables, cfg, capacity=2, max_len=max_len,
+                        prefill_chunk=args.prefill_chunk,
+                        prefix_cache=True, registry=MetricsRegistry())
+    plain = ServingEngine(variables, cfg, capacity=2, max_len=max_len,
+                          prefill_chunk=args.prefill_chunk,
+                          registry=MetricsRegistry())
+
+    def admit_timed(engine, prompt, budget=6):
+        req = engine.submit(Request(prompt, budget))
+        t0 = time.perf_counter()
+        while not req.tokens:
+            engine.step()
+        ttft = time.perf_counter() - t0
+        while not req.done:
+            engine.step()
+        return req, ttft
+
+    # warm the resident programs outside the timed admissions
+    admit_timed(eng, rs.randint(0, 256, (args.prefill_chunk,)
+                                ).astype(np.int32))
+    admit_timed(plain, rs.randint(0, 256, (args.prefill_chunk,)
+                                  ).astype(np.int32))
+
+    cold_ttft, warm_ttft, exact = [], [], True
+    for _ in range(args.prefix_pairs):
+        prefix = rs.randint(0, 256, (args.prefix_len,)).astype(np.int32)
+        a = np.concatenate([prefix,
+                            rs.randint(0, 256, (3,)).astype(np.int32)])
+        b = np.concatenate([prefix,
+                            rs.randint(0, 256, (3,)).astype(np.int32)])
+        ra, t_cold = admit_timed(eng, a)   # populates the chunk chain
+        rb, t_warm = admit_timed(eng, b)   # admits by restore
+        cold_ttft.append(t_cold)
+        warm_ttft.append(t_warm)
+        pa, _ = admit_timed(plain, a)
+        pb, _ = admit_timed(plain, b)
+        exact = (exact and np.array_equal(ra.output(), pa.output())
+                 and np.array_equal(rb.output(), pb.output()))
+    s = eng.metrics.summary()
+    stats = eng.pool.prefix.stats()
+    return {
+        "cold_admit_ttft_p50": percentile(cold_ttft, 50),
+        "warm_admit_ttft_p50": percentile(warm_ttft, 50),
+        "warm_over_cold": (percentile(warm_ttft, 50)
+                           / percentile(cold_ttft, 50)),
+        "hit_rate": stats["hit_rate"],
+        "cache_entries": stats["entries"],
+        "cache_bytes": stats["bytes"],
+        "chunks_restored": s["prefix_chunks_restored"],
+        "tokens_restored": s["prefix_tokens_restored"],
+        "bitwise_exact": bool(exact),
+    }
+
+
+def run_speculative(variables, cfg, args, trace):
+    """Accepted tokens per step with the target as its own draft (temp
+    0: acceptance is structural, every step emits lookahead+1), checked
+    bit-exact against the plain engine on the same trace."""
+    _, prompts, budgets = trace
+    spec = SpeculativeConfig(variables=variables, cfg=cfg,
+                             lookahead=args.lookahead)
+    eng = ServingEngine(variables, cfg, capacity=args.capacity,
+                        max_len=args.max_len,
+                        prefill_chunk=args.prefill_chunk,
+                        max_queue=args.num_requests,
+                        speculative=spec, registry=MetricsRegistry())
+    plain = ServingEngine(variables, cfg, capacity=args.capacity,
+                          max_len=args.max_len,
+                          prefill_chunk=args.prefill_chunk,
+                          max_queue=args.num_requests,
+                          registry=MetricsRegistry())
+    n = min(len(prompts), 2 * args.capacity)
+    sreqs = [eng.submit(Request(p, int(b)))
+             for p, b in zip(prompts[:n], budgets[:n])]
+    t0 = time.perf_counter()
+    eng.run()
+    spec_s = time.perf_counter() - t0
+    preqs = [plain.submit(Request(p, int(b)))
+             for p, b in zip(prompts[:n], budgets[:n])]
+    plain.run()
+    exact = all(np.array_equal(a.output(), b.output())
+                for a, b in zip(sreqs, preqs))
+    m = eng.metrics.summary()
+    return {
+        "lookahead": args.lookahead,
+        "accepted_per_step": m["accepted_per_step"],
+        "spec_steps": m["spec_steps"],
+        "tokens_generated": m["tokens_generated"],
+        "wall_s": spec_s,
+        "bitwise_exact": bool(exact),
+    }
+
+
+def check_resident(variables, cfg, args):
+    """The fixed-at-build-time resident-program contract, before and
+    after load."""
+    from bluefog_tpu.serving import engine as engine_mod
+
+    spec = SpeculativeConfig(variables=variables, cfg=cfg,
+                             lookahead=args.lookahead)
+    plain = ServingEngine(variables, cfg, capacity=2,
+                          max_len=args.max_len,
+                          prefill_chunk=args.prefill_chunk,
+                          registry=MetricsRegistry())
+    spece = ServingEngine(variables, cfg, capacity=2,
+                          max_len=args.max_len,
+                          prefill_chunk=args.prefill_chunk,
+                          speculative=spec, registry=MetricsRegistry())
+    before = (sorted(plain._resident), sorted(spece._resident))
+    rs = np.random.RandomState(args.seed + 4)
+    for e in (plain, spece):
+        for _ in range(3):
+            e.submit(Request(rs.randint(0, 256, (7,)).astype(np.int32),
+                             5))
+        e.run()
+    after = (sorted(plain._resident), sorted(spece._resident))
+    spec_cache = engine_mod._spec_step_prog._cache_size()
+    ok = (before == after
+          and before[0] == ["decode_step", "prefill_chunk"]
+          and before[1] == ["draft_prefill_chunk", "prefill_chunk",
+                            "spec_step"]
+          and sorted(plain.profile()) == before[0]
+          and sorted(spece.profile()) == before[1])
+    return {
+        "plain_resident": before[0],
+        "speculative_resident": before[1],
+        "plain_count": len(before[0]),
+        "speculative_count": len(before[1]),
+        "spec_step_compiles": int(spec_cache),
+        "fixed": bool(ok),
+    }
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32, dim=args.dim,
+                                  n_layers=args.layers,
+                                  hidden_dim=2 * args.dim)
+    variables = models.Llama(cfg).init(jax.random.PRNGKey(1),
+                                       jnp.zeros((2, 4), jnp.int32))
+    trace = make_trace(args)
+    for p, b in zip(trace[1], trace[2]):
+        assert p.size + b + args.lookahead <= args.max_len
+
+    step_cost = measure_step_cost(variables, cfg, args)
+    # arrivals in step-cost units: the queueing dynamics are then a
+    # pure function of the seed, and every virtual-time metric varies
+    # across hosts/runs only through the single measured constant
+    arrivals = trace[0] * (step_cost / args.arrivals_per_step)
+    trace = (arrivals, trace[1], trace[2])
+    fleet_one = run_fleet(variables, cfg, args, 1, trace, step_cost)
+    fleet_two = run_fleet(variables, cfg, args, 2, trace, step_cost)
+    fleet_two["fleet_speedup"] = (fleet_two["tokens_per_sec"]
+                                  / fleet_one["tokens_per_sec"])
+    prefix = run_prefix(variables, cfg, args)
+    speculative = run_speculative(variables, cfg, args, trace)
+    resident = check_resident(variables, cfg, args)
+
+    machine_checked = {
+        "fleet_two_beats_one": (fleet_two["tokens_per_sec"]
+                                > fleet_one["tokens_per_sec"]),
+        "fleet_load_spread": min(fleet_two["requests_per_replica"]) > 0,
+        "warm_prefix_beats_cold": (prefix["warm_admit_ttft_p50"]
+                                   < prefix["cold_admit_ttft_p50"]),
+        "prefix_bitwise_exact": prefix["bitwise_exact"],
+        "spec_accepted_per_step_gt_1":
+            speculative["accepted_per_step"] > 1.0,
+        "spec_temp0_bitwise_exact": speculative["bitwise_exact"],
+        "resident_count_fixed": resident["fixed"],
+    }
+    rec = {
+        "bench": "fleet_serving",
+        "config": {
+            "model": f"tiny(dim={args.dim},layers={args.layers})",
+            "num_requests": args.num_requests,
+            "arrivals_per_step": args.arrivals_per_step,
+            "capacity": args.capacity, "max_len": args.max_len,
+            "prefill_chunk": args.prefill_chunk,
+            "lookahead": args.lookahead,
+            "prefix_len": args.prefix_len, "seed": args.seed,
+            "backend": jax.default_backend(),
+        },
+        "fleet_one": fleet_one,
+        "fleet_two": fleet_two,
+        "prefix": prefix,
+        "speculative": speculative,
+        "resident": resident,
+        "machine_checked": machine_checked,
+    }
+    print(json.dumps(rec, indent=2))
+    failed = [k for k, v in machine_checked.items() if not v]
+    if failed:
+        print(f"[fleet-serving] FAILED claims: {failed}")
+        return 1
+    # gate BEFORE writing --out (rolling-baseline discipline, same as
+    # serving_bench.py)
+    if args.compare:
+        from bluefog_tpu.benchutil import bench_regression_gate
+
+        if not bench_regression_gate(rec, args.compare,
+                                     tolerance=args.tolerance):
+            print(f"[bench-gate] regression: NOT writing {args.out}")
+            return 1
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,9 @@ from bluefog_tpu.models import llama_generate
 from bluefog_tpu.serving import (Request, ServingEngine, ServingMetrics,
                                  percentile)
 
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "serving_baseline.json")
+
 parser = argparse.ArgumentParser()
 parser.add_argument("--num-requests", type=int, default=40)
 parser.add_argument("--rate", type=float, default=60.0,
@@ -70,8 +73,13 @@ parser.add_argument("--dim", type=int, default=256,
                     "measures the host loop, not batching policy)")
 parser.add_argument("--layers", type=int, default=6)
 parser.add_argument("--out", default="serving_bench_r07.json")
-parser.add_argument("--compare", metavar="PREV.json", default=None,
-                    help="regression gate: compare headline throughput/"
+parser.add_argument("--compare", metavar="PREV.json",
+                    default=(DEFAULT_BASELINE
+                             if os.path.exists(DEFAULT_BASELINE)
+                             else None),
+                    help="regression gate (default: the committed "
+                         "benchmarks/serving_baseline.json when present; "
+                         "pass '' to disable): compare headline throughput/"
                     "p99 fields against a prior record; exit 1 beyond "
                     "--tolerance")
 parser.add_argument("--tolerance", type=float, default=0.05)
@@ -194,8 +202,15 @@ def run_static(variables, cfg, args, arrivals, prompts, budgets):
     }
 
 
-def main():
-    args = parser.parse_args()
+def parse_args(argv=None):
+    args = parser.parse_args(argv)
+    if args.compare == "":
+        args.compare = None
+    return args
+
+
+def main(argv=None):
+    args = parse_args(argv)
     cfg = models.LlamaConfig.tiny(dtype=jnp.float32, dim=args.dim,
                                   n_layers=args.layers,
                                   hidden_dim=2 * args.dim)

@@ -24,6 +24,8 @@ __all__ = [
     "op_timeout",
     "fuse_epilogues",
     "fusion_threshold",
+    "kv_zero_on_free",
+    "prefix_cache_mb",
 ]
 
 
@@ -100,6 +102,32 @@ def fuse_epilogues() -> bool:
     (tests/test_epilogue.py)."""
     return _env("BLUEFOG_FUSE_EPILOGUES", "1") not in ("0", "false",
                                                        "False")
+
+
+def kv_zero_on_free() -> bool:
+    """BLUEFOG_KV_ZERO_ON_FREE (default OFF): whether
+    :meth:`bluefog_tpu.serving.SlotPool.free` zeroes the freed slot's
+    whole K/V cache.  The default resets only the slot's ``cache_index``
+    leaves — correctness needs nothing more (everything above the index
+    is invisible behind the causal mask and gets overwritten as the next
+    request writes its own positions), and the full-slot zero is a
+    whole-slot HBM write per retirement that also destroys K/V the
+    prefix cache could have served.  ``1`` restores the old
+    zero-everything behavior (a debugging aid: a zeroed pool makes
+    "reuse leaves no trace" literal instead of masked)."""
+    return _env("BLUEFOG_KV_ZERO_ON_FREE", "0") in ("1", "true", "True")
+
+
+def prefix_cache_mb() -> int:
+    """BLUEFOG_PREFIX_CACHE_MB (default 64): host-side byte budget of the
+    serving prefix cache (:mod:`bluefog_tpu.serving.prefix_cache`), in
+    MiB.  Evicted K/V chunks are retained up to this bound (LRU) so
+    requests sharing a prompt prefix admit by copying cached chunks
+    instead of re-running prefill.  0 disables retention."""
+    try:
+        return int(_env("BLUEFOG_PREFIX_CACHE_MB", "64"))
+    except ValueError:
+        return 64
 
 
 def fusion_threshold() -> int:

@@ -1204,7 +1204,8 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, pos_offset=0, return_hidden=False):
+    def __call__(self, tokens, pos_offset=0, return_hidden=False,
+                 all_logits=False):
         """tokens: [B, T_local] int32 -> logits [B, T_local, vocab] f32
         (with ``cfg.vocab_parallel``: [B, T_local, vocab/tp] — this
         shard's columns; train against ``vocab_parallel_xent``).
@@ -1215,7 +1216,14 @@ class Llama(nn.Module):
         (``llama_chunked_xent_loss_fn``), which never materializes the
         full [B, T, vocab] logits.  Init with the default so the head
         params exist; apply-with-return_hidden simply leaves them
-        unused."""
+        unused.
+
+        ``all_logits=True`` keeps every position's logits in decode
+        layout (normally only the final position survives — generation
+        samples nothing else).  Speculative decoding's verify step needs
+        it: ONE multi-token cached forward scores a whole draft window,
+        so acceptance reads the target distribution at each drafted
+        position.  No-op outside decode layout."""
         cfg = self.cfg
         assert tokens.shape[1] <= cfg.max_seq_len, (
             f"sequence shard {tokens.shape[1]} exceeds max_seq_len "
@@ -1264,7 +1272,7 @@ class Llama(nn.Module):
         x = RMSNorm(cfg.norm_eps,
                     grad_psum_axis=cfg.tp_axis if cfg.tp_seq_shard
                     else None, name="norm")(x)
-        if cfg.decode:
+        if cfg.decode and not all_logits:
             # generation only ever samples from the final position — skip
             # the other T-1 head matmuls and the [B, T, vocab] logits
             # buffer (at 8k prompt x 128k vocab that is ~4 GB of f32)

@@ -1,4 +1,4 @@
-"""Continuous-batching serving engine.
+"""Continuous-batching serving engine, fleet-scale.
 
 Turns the K/V-cached decode substrate (``models/generate.py``,
 ``models/quant.py``, ``parallel/pallas_decode.py``) into the serving
@@ -6,14 +6,25 @@ path the ROADMAP north star requires: a slot-pooled resident program
 that admits requests as they arrive, mixes chunked prefill with batched
 decode every step, and retires slots on EOS / budget / deadline —
 no recompiles across arrival patterns, token-exact with the one-shot
-``llama_generate`` path.  See docs/serving.md.
+``llama_generate`` path.  On top of the single engine: chunk-hashed
+prefix/KV reuse (``prefix_cache``), speculative decoding as a resident
+draft/verify program pair (``SpeculativeConfig``), and a decentralized
+multi-replica router fed by gossiped serving gauges (``fleet``).  See
+docs/serving.md.
 """
 
 from bluefog_tpu.serving.engine import (Request, RequestRejected,
-                                        ServingEngine)
+                                        ServingEngine, SpeculativeConfig)
+from bluefog_tpu.serving.fleet import (FleetRouter, FleetSaturated,
+                                       RouterSnapshot,
+                                       collect_serving_signals)
 from bluefog_tpu.serving.kv_pool import SlotPool
 from bluefog_tpu.serving.metrics import ServingMetrics, percentile
+from bluefog_tpu.serving.prefix_cache import PrefixCache
 from bluefog_tpu.serving.scheduler import FifoScheduler
 
-__all__ = ["ServingEngine", "Request", "RequestRejected", "SlotPool",
-           "FifoScheduler", "ServingMetrics", "percentile"]
+__all__ = ["ServingEngine", "Request", "RequestRejected",
+           "SpeculativeConfig", "SlotPool", "PrefixCache",
+           "FleetRouter", "FleetSaturated", "RouterSnapshot",
+           "collect_serving_signals", "FifoScheduler", "ServingMetrics",
+           "percentile"]

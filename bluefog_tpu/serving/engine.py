@@ -19,10 +19,24 @@ arrival pattern:
 * slots retire on EOS / token budget / deadline / cancellation and are
   zeroed for reuse.
 
-There are exactly three compiled programs per model config — prefill
-chunk, decode horizon, slot zero — and their shapes depend only on
-``(capacity, max_len, prefill_chunk, decode_horizon)``, never on the
-arrival pattern: no recompiles across requests.
+The resident program set is FIXED AT BUILD TIME and its shapes depend
+only on ``(capacity, max_len, prefill_chunk, decode_horizon)`` — never
+on the arrival pattern: no recompiles across requests.  A plain engine
+residents a prefill-chunk and a decode-step program (plus the slot
+housekeeping scatter); a :class:`SpeculativeConfig` swaps the decode
+step for a draft/verify pair — the draft model proposes ``lookahead``
+tokens through the same single-token step, ONE multi-token target
+forward scores the whole window (``verify_window``), and acceptance is
+rejection sampling (token-exact greedy at temperature 0).  Either way
+the count is fixed before the first request arrives, and
+:meth:`ServingEngine.profile` enumerates whatever is resident.
+
+Two optional subsystems ride the same fixed programs: a
+:class:`~bluefog_tpu.serving.prefix_cache.PrefixCache` admits requests
+that share a prompt prefix by COPYING cached K/V chunks into the slot
+instead of re-running prefill (chain-hashed whole chunks — bit-exact vs
+cold prefill), and ``registry=`` isolates the engine's metrics for
+multi-replica fleets (:mod:`bluefog_tpu.serving.fleet`).
 
 Numerics are the one-shot path's numerics: both are built from the same
 :func:`prefill_cache` / :func:`decode_token_step` pieces, so a GREEDY
@@ -52,13 +66,14 @@ import numpy as np
 from jax import lax
 
 from bluefog_tpu.models.generate import (decode_config, decode_token_step,
-                                         prefill_cache)
+                                         prefill_cache, verify_window)
 from bluefog_tpu.models.llama import Llama, LlamaConfig
 from bluefog_tpu.serving.kv_pool import SlotPool
 from bluefog_tpu.serving.metrics import ServingMetrics
 from bluefog_tpu.serving.scheduler import FifoScheduler, RequestRejected
 
-__all__ = ["ServingEngine", "Request", "RequestRejected"]
+__all__ = ["ServingEngine", "Request", "RequestRejected",
+           "SpeculativeConfig"]
 
 _rid_counter = itertools.count()
 
@@ -94,6 +109,8 @@ class Request:
     slot: Optional[int] = dataclasses.field(default=None, init=False)
     _prefill_pos: int = dataclasses.field(default=0, init=False)
     _cancel: bool = dataclasses.field(default=False, init=False)
+    _prefix_keys: Optional[List[str]] = dataclasses.field(default=None,
+                                                          init=False)
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -211,6 +228,124 @@ def _decode_step_prog(params, pool, toks, active, keys, counts, temps,
     return pool, hist
 
 
+@dataclasses.dataclass(frozen=True)
+class SpeculativeConfig:
+    """Draft model spec for speculative decoding.
+
+    ``variables``/``cfg`` are the DRAFT model (same vocabulary as the
+    target; typically much smaller).  Each engine step the draft
+    proposes ``lookahead`` tokens through the resident single-token
+    step, the target scores the whole window in ONE multi-token forward
+    (:func:`~bluefog_tpu.models.generate.verify_window`), and standard
+    rejection sampling accepts a prefix of the proposals plus one
+    correction/bonus token — so every step emits between 1 and
+    ``lookahead + 1`` tokens with the TARGET model's distribution
+    (bit-exact greedy argmax at temperature 0; provably unbiased
+    sampling otherwise).  The engine reserves ``lookahead`` cache
+    positions of headroom per slot (checked at submit)."""
+
+    variables: dict
+    cfg: LlamaConfig
+    lookahead: int = 4
+    weight_quant: str = "none"
+
+
+@partial(jax.jit, static_argnames=("cfg_t", "cfg_d", "k"),
+         donate_argnums=(2, 3))
+def _spec_step_prog(params_t, params_d, pool_t, pool_d, toks, active,
+                    keys, counts, temps, cfg_t: LlamaConfig,
+                    cfg_d: LlamaConfig, k: int):
+    """One speculative decode step for EVERY slot: draft ``k`` proposals
+    (a ``k+1``-step single-token scan — the extra step writes the last
+    proposal's K/V so the draft cache index stays position-aligned
+    whatever gets accepted), verify the window in one multi-token target
+    forward, accept by rejection sampling, and emit ``n_acc + 1`` tokens
+    per slot (accepted prefix + correction/bonus).
+
+    Exactness at temperature 0: the accepted tokens ARE the target's
+    greedy argmaxes (acceptance literally compares them), and the
+    correction token is the argmax after the accepted prefix — the
+    emitted stream is bitwise the non-speculative greedy stream, relying
+    only on the row-wise bit-stability of the multi-token forward that
+    chunked prefill already depends on.  At temperature > 0 the
+    accept-with-``min(1, p/q)`` + residual-resample scheme emits tokens
+    distributed exactly as target sampling (Leviathan et al.) — streams
+    are deterministic per request (salted ``fold_in`` chains off the
+    request seed and token count) but follow a different rng chain than
+    the non-speculative step.
+
+    Cache discipline: both pools' writes advance ``k + 1`` positions;
+    the per-slot index is corrected to ``old + n_emit`` (0 for inactive
+    slots), so rejected drafts sit ABOVE the index where the causal
+    mask hides them until real tokens overwrite — the same invariant
+    padded prefill chunks use.  Returns
+    ``(pool_t, pool_d, emitted [cap, k+1], n_emit [cap])``."""
+    target = Llama(cfg_t)
+    draft = Llama(cfg_d)
+
+    def one(cache_t, cache_d, tok, act, key, count, temp):
+        old_t, old_d = cache_t, cache_d
+        tmp = jnp.maximum(temp, 1e-6)
+
+        def dstep(carry, i):
+            cache_d, cur = carry
+            last, cache_d = decode_token_step(draft, params_d, cache_d,
+                                              cur[None, None])
+            lg = last[0]
+            nxt = _sample(lg, jax.random.fold_in(
+                jax.random.fold_in(key, 1), count + i), temp)
+            return (cache_d, nxt), (cur, nxt, lg)
+
+        (cache_d, _), (window, props, dlg) = lax.scan(
+            dstep, (cache_d, tok), jnp.arange(k + 1, dtype=jnp.int32))
+        # window = [cur, d_1..d_k] (the tokens whose K/V lands in the
+        # cache); props = [d_1..d_{k+1}] (the k+1-th proposal is only
+        # drafted so d_k's K/V gets written — it is never considered);
+        # dlg[i] is the draft distribution that proposed props[i]
+        vlogits, cache_t = verify_window(target, params_t, cache_t,
+                                         window[None])
+        vlogits = vlogits[0]                          # [k+1, V]
+        tgt = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+
+        # greedy acceptance: leading run where draft == target argmax
+        hit = (props[:k] == tgt[:k]).astype(jnp.int32)
+        acc_greedy = jnp.cumprod(hit).sum()
+        # rejection sampling: accept d_i with prob min(1, p_i/q_i)
+        p = jax.nn.softmax(vlogits / tmp, axis=-1)    # [k+1, V]
+        q = jax.nn.softmax(dlg / tmp, axis=-1)        # [k+1, V]
+        idx = jnp.arange(k)
+        ratio = (p[idx, props[:k]]
+                 / jnp.maximum(q[idx, props[:k]], 1e-30))
+        u = jax.vmap(lambda i: jax.random.uniform(jax.random.fold_in(
+            jax.random.fold_in(key, 2), count + i)))(idx)
+        ok = (u < jnp.minimum(ratio, 1.0)).astype(jnp.int32)
+        acc_sample = jnp.cumprod(ok).sum()
+        n_acc = jnp.where(temp > 0.0, acc_sample, acc_greedy)
+
+        # correction token after the accepted prefix: residual resample
+        # max(0, p - q) on a rejection, plain target sample on the
+        # all-accepted bonus (no draft proposed there, q := 0)
+        p_row = p[n_acc]
+        q_row = jnp.where(n_acc < k, q[n_acc], 0.0)
+        resid = jnp.maximum(p_row - q_row, 0.0)
+        rsum = resid.sum()
+        resid = jnp.where(rsum > 1e-30, resid / jnp.maximum(rsum, 1e-30),
+                          p_row)
+        corr_sample = jax.random.categorical(
+            jax.random.fold_in(jax.random.fold_in(key, 3), count + n_acc),
+            jnp.log(jnp.maximum(resid, 1e-38))).astype(jnp.int32)
+        corr = jnp.where(temp > 0.0, corr_sample, tgt[n_acc])
+
+        n_emit = jnp.where(act, n_acc + 1, 0)
+        emitted = jnp.where(jnp.arange(k + 1) < n_acc, props, corr)
+        cache_t = _corrected_index(cache_t, old_t, n_emit)
+        cache_d = _corrected_index(cache_d, old_d, n_emit)
+        return cache_t, cache_d, emitted, n_emit
+
+    return jax.vmap(one)(pool_t, pool_d, toks, active, keys, counts,
+                         temps)
+
+
 class ServingEngine:
     """Continuous-batching serving loop over a :class:`SlotPool`.
 
@@ -247,6 +382,24 @@ class ServingEngine:
       decode_attn: attention lowering for the resident programs ("xla"
         default — the vmapped per-slot step; the fused Pallas kernel is
         a single-request-batch kernel, measure before switching).
+      registry: explicit metrics registry for this engine's
+        :class:`ServingMetrics` (default: the global observe registry).
+        A multi-replica fleet gives each replica its own so the router
+        can read per-replica occupancy/queue/TTFT signals
+        (:mod:`bluefog_tpu.serving.fleet`).
+      zero_on_free: passed to :class:`SlotPool` (default: the
+        ``BLUEFOG_KV_ZERO_ON_FREE`` env knob, off).
+      prefix_cache: ``True`` builds a
+        :class:`~bluefog_tpu.serving.prefix_cache.PrefixCache` sized by
+        ``prefix_cache_bytes`` (default ``BLUEFOG_PREFIX_CACHE_MB``);
+        or pass an instance to share/inspect it.  Admission then
+        restores any chain-hash-matched prompt chunks by device copy
+        and prefills only the novel tail — bit-exact vs cold prefill.
+      speculative: a :class:`SpeculativeConfig` — swaps the resident
+        decode step for the draft/verify program pair.  Requires
+        ``decode_horizon=1`` (a speculative step already advances up to
+        ``lookahead+1`` tokens) and reserves ``lookahead`` cache
+        positions of headroom per request (checked at submit).
     """
 
     def __init__(self, variables, cfg: LlamaConfig, *, capacity: int,
@@ -255,7 +408,11 @@ class ServingEngine:
                  kv_quant: str = "none", weight_quant: str = "none",
                  max_queue: int = 64,
                  clock: Optional[Callable[[], float]] = None,
-                 decode_attn: str = "xla"):
+                 decode_attn: str = "xla", registry=None,
+                 zero_on_free: Optional[bool] = None,
+                 prefix_cache=False,
+                 prefix_cache_bytes: Optional[int] = None,
+                 speculative: Optional[SpeculativeConfig] = None):
         from bluefog_tpu.models.quant import is_quantized_params
 
         if (weight_quant != "none") != is_quantized_params(variables):
@@ -281,12 +438,65 @@ class ServingEngine:
         if prefill_budget < 1:
             raise ValueError(f"prefill_budget ({prefill_budget}) must be "
                              ">= 1")
+        if speculative is not None:
+            if decode_horizon != 1:
+                raise ValueError(
+                    "speculative decoding requires decode_horizon=1 (a "
+                    "speculative step already advances up to lookahead+1 "
+                    f"tokens); got decode_horizon={decode_horizon}")
+            if speculative.lookahead < 1:
+                raise ValueError(
+                    f"lookahead ({speculative.lookahead}) must be >= 1")
+            if speculative.cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab ({speculative.cfg.vocab_size}) != "
+                    f"target vocab ({cfg.vocab_size}) — speculative "
+                    "decoding needs one tokenizer")
+            if ((speculative.weight_quant != "none")
+                    != is_quantized_params(speculative.variables)):
+                raise ValueError(
+                    "SpeculativeConfig.weight_quant does not match the "
+                    "draft param tree (quantize_llama_params contract)")
         self.cfg = decode_config(cfg, max_len, kv_quant=kv_quant,
                                  weight_quant=weight_quant,
                                  decode_attn=decode_attn)
-        self.pool = SlotPool(cfg, capacity, max_len, kv_quant=kv_quant)
+        from bluefog_tpu.serving.prefix_cache import PrefixCache
+
+        prefix = None
+        # NB: isinstance first — an EMPTY PrefixCache is falsy (__len__)
+        if isinstance(prefix_cache, PrefixCache) or prefix_cache:
+            prefix = (prefix_cache if isinstance(prefix_cache, PrefixCache)
+                      else PrefixCache(prefill_chunk, prefix_cache_bytes))
+            if prefix.chunk != prefill_chunk:
+                raise ValueError(
+                    f"prefix cache chunk ({prefix.chunk}) != prefill_chunk"
+                    f" ({prefill_chunk}) — hashes must match the chunk "
+                    "grid prefill writes")
+        self.pool = SlotPool(cfg, capacity, max_len, kv_quant=kv_quant,
+                             zero_on_free=zero_on_free, prefix=prefix)
+        self._spec = speculative
+        self._draft_pool: Optional[SlotPool] = None
+        self._draft_params = None
+        self.draft_cfg: Optional[LlamaConfig] = None
+        if speculative is not None:
+            from bluefog_tpu.serving.prefix_cache import PrefixCache
+
+            dprefix = (PrefixCache(prefill_chunk,
+                                   prefix_cache_bytes)
+                       if prefix is not None else None)
+            self.draft_cfg = decode_config(
+                speculative.cfg, max_len, kv_quant=kv_quant,
+                weight_quant=speculative.weight_quant,
+                decode_attn=decode_attn)
+            # the draft pool mirrors the target pool's alloc/free order,
+            # so slot i means the same request in both trees
+            self._draft_pool = SlotPool(speculative.cfg, capacity,
+                                        max_len, kv_quant=kv_quant,
+                                        zero_on_free=zero_on_free,
+                                        prefix=dprefix)
+            self._draft_params = speculative.variables["params"]
         self.scheduler = FifoScheduler(max_queue=max_queue)
-        self.metrics = ServingMetrics()
+        self.metrics = ServingMetrics(registry=registry)
         self.prefill_chunk = prefill_chunk
         self.decode_horizon = decode_horizon
         self.prefill_budget = prefill_budget
@@ -294,6 +504,7 @@ class ServingEngine:
         self._params = variables["params"]
         self._running: Dict[int, Request] = {}   # slot -> request
         self._admitting: Optional[Request] = None  # mid-prefill request
+        self._resident = self._build_resident()
 
     # -- submission ---------------------------------------------------- #
     def submit(self, request: Request) -> Request:
@@ -301,6 +512,13 @@ class ServingEngine:
         backpressure (queue at ``max_queue``) and ``ValueError`` when the
         request cannot fit a slot at all."""
         total = request.prompt.size + request.max_new_tokens
+        if self._spec is not None:
+            # a speculative step may write lookahead draft positions
+            # past the final emitted token; reserving that headroom at
+            # admission keeps every window inside the slot (an
+            # overrunning dynamic_update_slice start would CLAMP and
+            # silently overwrite real K/V)
+            total += self._spec.lookahead
         if total > self.pool.max_len:
             # refusal paths agree: a request the engine will never run
             # is terminal (done == True) AND counted in n_rejected,
@@ -312,7 +530,9 @@ class ServingEngine:
             raise ValueError(
                 f"request needs {total} cache positions but slots hold "
                 f"{self.pool.max_len} (prompt {request.prompt.size} + "
-                f"max_new_tokens {request.max_new_tokens})")
+                f"max_new_tokens {request.max_new_tokens}"
+                + (f" + speculative headroom {self._spec.lookahead}"
+                   if self._spec is not None else "") + ")")
         now = self.clock()
         try:
             self.scheduler.submit(request)
@@ -368,8 +588,19 @@ class ServingEngine:
                 if req is None:
                     break
                 req.slot = self.pool.alloc()
+                if self._draft_pool is not None:
+                    dslot = self._draft_pool.alloc()
+                    assert dslot == req.slot, (dslot, req.slot)
                 self.metrics.on_admit(req.rid, now)
                 if req.prompt.size > 1:
+                    self._restore_prefix(req)  # no-op without the cache
+                    if req._prefill_pos >= req.prompt.size - 1:
+                        # the whole prefill region came out of the
+                        # prefix cache — straight to decode, zero
+                        # prefill compute spent
+                        req.state = DECODE
+                        self._running[req.slot] = req
+                        continue
                     req.state = PREFILL
                     self._admitting = req
                 else:  # single-token prompt: nothing to prefill — the
@@ -383,7 +614,10 @@ class ServingEngine:
         decoding = {s: r for s, r in self._running.items()
                     if r.state == DECODE}
         if decoding:
-            self._decode_step(decoding)
+            if self._spec is not None:
+                self._spec_decode_step(decoding)
+            else:
+                self._decode_step(decoding)
         self.metrics.on_step(self.pool.occupancy(),
                              self.scheduler.queue_depth,
                              time.perf_counter() - t_step)
@@ -399,32 +633,104 @@ class ServingEngine:
                 return
         raise RuntimeError(f"engine still busy after {max_steps} steps")
 
+    def _build_resident(self) -> Dict[str, tuple]:
+        """The engine's resident data-plane executables, fixed at build
+        time: ``{name: (jitted_fn, example_args_thunk, static_kwargs)}``.
+        A plain engine residents the prefill chunk + decode step; a
+        speculative engine swaps the decode step for the draft-prefill /
+        draft+verify pair.  :meth:`profile` (and any future
+        introspection) enumerates THIS dict instead of hardcoding the
+        program list, so new programs are profiled without another
+        special case.  (The slot-housekeeping scatters — zero /
+        index-reset on free — are deliberately not listed: they are
+        O(slot) bookkeeping, not the serving data plane.)"""
+        cap = self.pool.capacity
+
+        def decode_args(pool):
+            return lambda: (
+                self._params, pool.cache, jnp.zeros((cap,), jnp.int32),
+                jnp.zeros((cap,), bool), jnp.zeros((cap, 2), jnp.uint32),
+                jnp.zeros((cap,), jnp.int32), jnp.zeros((cap,),
+                                                        jnp.float32))
+
+        resident: Dict[str, tuple] = {
+            "prefill_chunk": (
+                _prefill_chunk_prog,
+                lambda: (self._params, self.pool.cache, jnp.int32(0),
+                         jnp.zeros((1, self.prefill_chunk), jnp.int32),
+                         jnp.int32(0)),
+                {"cfg": self.cfg}),
+        }
+        if self._spec is None:
+            resident["decode_step"] = (
+                _decode_step_prog, decode_args(self.pool),
+                {"cfg": self.cfg, "horizon": self.decode_horizon})
+        else:
+            resident["draft_prefill_chunk"] = (
+                _prefill_chunk_prog,
+                lambda: (self._draft_params, self._draft_pool.cache,
+                         jnp.int32(0),
+                         jnp.zeros((1, self.prefill_chunk), jnp.int32),
+                         jnp.int32(0)),
+                {"cfg": self.draft_cfg})
+            resident["spec_step"] = (
+                _spec_step_prog,
+                lambda: (self._params, self._draft_params,
+                         self.pool.cache, self._draft_pool.cache,
+                         jnp.zeros((cap,), jnp.int32),
+                         jnp.zeros((cap,), bool),
+                         jnp.zeros((cap, 2), jnp.uint32),
+                         jnp.zeros((cap,), jnp.int32),
+                         jnp.zeros((cap,), jnp.float32)),
+                {"cfg_t": self.cfg, "cfg_d": self.draft_cfg,
+                 "k": self._spec.lookahead})
+        return resident
+
     def profile(self, **kw) -> Dict[str, "object"]:
         """HLO-attributed :class:`~bluefog_tpu.observe.StepProfile` of
-        the two resident device programs (``prefill_chunk`` and
-        ``decode_step``), via :func:`bluefog_tpu.observe.profile_step`.
-        AOT — compiles (hitting the jit cache when the engine already
-        ran) but executes nothing, so it is safe on a live engine.
-        Keyword args (``step_seconds``, chip figures, ...) pass
-        through; the serving bench emits these instead of hand-rolled
-        cost dicts."""
+        EVERY resident device program — enumerated generically from the
+        build-time registry (``prefill_chunk`` + ``decode_step`` for a
+        plain engine; ``prefill_chunk`` + ``draft_prefill_chunk`` +
+        ``spec_step`` for a speculative one), via
+        :func:`bluefog_tpu.observe.profile_step`.  AOT — compiles
+        (hitting the jit cache when the engine already ran) but executes
+        nothing, so it is safe on a live engine.  Keyword args
+        (``step_seconds``, chip figures, ...) pass through; the serving
+        bench emits these instead of hand-rolled cost dicts."""
         from bluefog_tpu.observe import profile_step
 
-        cap = self.pool.capacity
-        prefill = profile_step(
-            _prefill_chunk_prog, self._params, self.pool.cache,
-            jnp.int32(0), jnp.zeros((1, self.prefill_chunk), jnp.int32),
-            jnp.int32(0), cfg=self.cfg,
-            name="serving.prefill_chunk", **kw)
-        decode = profile_step(
-            _decode_step_prog, self._params, self.pool.cache,
-            jnp.zeros((cap,), jnp.int32), jnp.zeros((cap,), bool),
-            jnp.zeros((cap, 2), jnp.uint32), jnp.zeros((cap,), jnp.int32),
-            jnp.zeros((cap,), jnp.float32), cfg=self.cfg,
-            horizon=self.decode_horizon, name="serving.decode_step", **kw)
-        return {"prefill_chunk": prefill, "decode_step": decode}
+        return {name: profile_step(fn, *args(),
+                                   name=f"serving.{name}", **static, **kw)
+                for name, (fn, args, static) in self._resident.items()}
 
     # -- internals ----------------------------------------------------- #
+    def _restore_prefix(self, req: Request) -> int:
+        """Admission-time prefix reuse: chain-hash the prompt's full
+        chunks and device-copy the longest cached run into the slot
+        (both pools, lockstep, under speculation — target and draft K/V
+        are different tensors for the same tokens, so the usable prefix
+        is the MINIMUM of the two matches).  Advances ``_prefill_pos``
+        past the restored region; restores do not consume prefill
+        budget (they replace the model forward, not ride next to it)."""
+        if self.pool.prefix is None:
+            return 0
+        keys = self.pool.prefix.chunk_keys(req.prompt)
+        req._prefix_keys = keys
+        if not keys:
+            return 0
+        matched = self.pool.prefix.match(keys)
+        if self._draft_pool is not None:
+            matched = min(matched,
+                          self._draft_pool.prefix.match(keys))
+        if matched:
+            self.pool.restore_prefix(req.slot, keys, matched)
+            if self._draft_pool is not None:
+                self._draft_pool.restore_prefix(req.slot, keys, matched)
+            req._prefill_pos = matched * self.prefill_chunk
+            self.metrics.on_prefix_restore(
+                req.rid, matched, matched * self.prefill_chunk)
+        return matched
+
     def _prefill_one_chunk(self, req: Request) -> None:
         # chunks cover prompt[:-1] — the K/V everyone after needs; the
         # final prompt token goes through the decode step below, whose
@@ -436,9 +742,27 @@ class ServingEngine:
         valid = min(c, n_prefill - pos)
         chunk = np.zeros((1, c), np.int32)
         chunk[0, :valid] = req.prompt[pos:pos + valid]
+        chunk = jnp.asarray(chunk)
         self.pool.cache = _prefill_chunk_prog(
             self._params, self.pool.cache, jnp.int32(req.slot),
-            jnp.asarray(chunk), jnp.int32(valid), cfg=self.cfg)
+            chunk, jnp.int32(valid), cfg=self.cfg)
+        if self._draft_pool is not None:
+            # the draft model needs the SAME context in its own cache;
+            # its chunk rides the target's budget slot (one admission
+            # unit of work, two trees)
+            self._draft_pool.cache = _prefill_chunk_prog(
+                self._draft_params, self._draft_pool.cache,
+                jnp.int32(req.slot), chunk, jnp.int32(valid),
+                cfg=self.draft_cfg)
+        self.metrics.on_prefill_chunk()
+        if (valid == c and req._prefix_keys
+                and pos // c < len(req._prefix_keys)):
+            # a FULL cold chunk just landed on the chunk grid — stash
+            # its K/V while it provably matches the chain hash
+            key = req._prefix_keys[pos // c]
+            self.pool.stash_chunk(req.slot, key, pos)
+            if self._draft_pool is not None:
+                self._draft_pool.stash_chunk(req.slot, key, pos)
         req._prefill_pos = pos + valid
         if req._prefill_pos < n_prefill:
             return  # more chunks to go; decodes keep running meanwhile
@@ -481,6 +805,51 @@ class ServingEngine:
                     break  # surplus horizon tokens for a retired slot
                     # are discarded (its cache is zeroed on free)
 
+    def _spec_decode_step(self, decoding: Dict[int, Request]) -> None:
+        """The speculative twin of :meth:`_decode_step`: one resident
+        draft/verify program advances every active slot by 1 to
+        ``lookahead+1`` tokens.  The host appends each slot's emitted
+        run with the same EOS/budget truncation the plain path applies —
+        surplus accepted tokens past a retirement are discarded (the
+        freed slot's index reset makes their cache writes
+        unobservable)."""
+        cap = self.pool.capacity
+        toks = np.zeros((cap,), np.int32)
+        active = np.zeros((cap,), bool)
+        keys = np.zeros((cap, 2), np.uint32)
+        counts = np.zeros((cap,), np.int32)
+        temps = np.zeros((cap,), np.float32)
+        for slot, req in decoding.items():
+            toks[slot] = req.tokens[-1] if req.tokens else req.prompt[-1]
+            active[slot] = True
+            keys[slot] = np.asarray(jax.random.PRNGKey(req.seed))
+            counts[slot] = len(req.tokens)
+            temps[slot] = req.temperature
+        (self.pool.cache, self._draft_pool.cache, hist,
+         n_emit) = _spec_step_prog(
+            self._params, self._draft_params, self.pool.cache,
+            self._draft_pool.cache, jnp.asarray(toks),
+            jnp.asarray(active), jnp.asarray(keys), jnp.asarray(counts),
+            jnp.asarray(temps), cfg_t=self.cfg, cfg_d=self.draft_cfg,
+            k=self._spec.lookahead)
+        hist = np.asarray(hist)      # [cap, lookahead+1]
+        n_emit = np.asarray(n_emit)  # [cap]
+        now = self.clock()
+        emitted = 0
+        for slot, req in decoding.items():
+            for j in range(int(n_emit[slot])):
+                first = not req.tokens
+                req.tokens.append(int(hist[slot, j]))
+                emitted += 1
+                if first:
+                    self.metrics.on_first_token(req.rid, now)
+                else:
+                    self.metrics.on_token(req.rid, now)
+                if self._maybe_finish(req):
+                    break  # surplus accepted tokens for a retired slot
+                    # are discarded (index reset on free)
+        self.metrics.on_spec_step(len(decoding), emitted)
+
     def _maybe_finish(self, req: Request) -> bool:
         hit_eos = (req.eos_id is not None
                    and req.tokens[-1] == req.eos_id)
@@ -494,6 +863,8 @@ class ServingEngine:
             self._admitting = None
         self._running.pop(req.slot, None)
         self.pool.free(req.slot)
+        if self._draft_pool is not None:
+            self._draft_pool.free(req.slot)
         req.slot = None
         req.state = outcome
         self.metrics.on_retire(req.rid, now, outcome)

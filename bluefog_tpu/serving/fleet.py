@@ -1,0 +1,292 @@
+"""Decentralized multi-replica request routing over gossiped gauges.
+
+A fleet of :class:`~bluefog_tpu.serving.ServingEngine` replicas needs a
+way to spread load, and the paper's whole premise is that coordination
+does not require a center: just as training averages parameters by
+push-sum over a sparse topology instead of an allreduce, the fleet
+spreads requests by GOSSIPING each replica's serving gauges — slot
+occupancy, queue depth, TTFT p50 — through
+:class:`bluefog_tpu.observe.fleet.FleetAggregator` and letting every
+participant rank replicas from its own converged view.  There is no
+load-balancer process to deploy, scale, or lose.
+
+The per-replica signals survive the mean-reducing gossip through the
+ONE-HOT BLOCK layout: replica *i* contributes a ``[n*k]`` row that is
+zero outside its own ``k``-wide block.  Push-sum converges every column
+to its live mean, so column ``i*k + m`` lands at ``signal[i, m] /
+n_live`` everywhere — multiplying back by the live count recovers the
+full ``[n, k]`` signal matrix at EVERY rank, exactly (the de-biased
+push-sum fixed point), at the cost of gossiping ``n*k`` scalars instead
+of ``k``.  Fine for fleet-sized ``n``.
+
+Routing is then pure local arithmetic on the snapshot: replicas are
+ranked by a weighted score (queue depth dominates, then occupancy, then
+normalized TTFT; index breaks ties) and :meth:`FleetRouter.submit`
+walks that order, letting each replica's own
+:class:`~bluefog_tpu.serving.RequestRejected` backpressure stand — a
+replica never takes a request its queue cannot hold.  When every
+replica refuses, :class:`FleetSaturated` (a ``RequestRejected``
+subclass, so existing client backoff code keeps working) carries all
+the per-replica depths.
+
+Determinism: routing decisions are a pure function of the snapshot, and
+the snapshot is a pure function of the registries and the topology
+schedule — no RNG, no wall clock — so two routers over the same state
+route identically (property-tested).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bluefog_tpu.observe.fleet import FleetAggregator
+from bluefog_tpu.serving.scheduler import RequestRejected
+
+__all__ = ["FleetRouter", "FleetSaturated", "RouterSnapshot",
+           "collect_serving_signals", "SIGNAL_NAMES"]
+
+# gossiped per-replica serving signals, in block order
+SIGNAL_NAMES = ("occupancy", "queue_depth", "ttft_p50")
+
+
+def collect_serving_signals(registry) -> Dict[str, float]:
+    """Scrape one replica's routing signals out of its (isolated)
+    metrics registry: the ``bf_serving_slot_occupancy`` /
+    ``bf_serving_queue_depth`` gauges the engine sets every step and the
+    ``bf_serving_ttft_seconds`` windowed-histogram p50.  Zeros where the
+    engine has not published yet — a fresh replica looks maximally
+    attractive, which is the right cold-start bias."""
+    occupancy = 0.0
+    queue_depth = 0.0
+    ttft_p50 = 0.0
+    for name, kind, _help, _labels, m in registry.collect():
+        if name == "bf_serving_slot_occupancy" and kind == "gauge":
+            occupancy = float(m.value)
+        elif name == "bf_serving_queue_depth" and kind == "gauge":
+            queue_depth = float(m.value)
+        elif name == "bf_serving_ttft_seconds" and kind == "histogram":
+            ttft_p50 = float(m.percentile(50))
+    return {"occupancy": occupancy, "queue_depth": queue_depth,
+            "ttft_p50": ttft_p50}
+
+
+class FleetSaturated(RequestRejected):
+    """Every replica refused the request.  ``queue_depths[i]`` is the
+    depth replica *i* reported in its own rejection — the fleet-wide
+    backpressure picture, for clients that scale their backoff."""
+
+    def __init__(self, queue_depths: Sequence[int], max_queue: int):
+        depths = [int(d) for d in queue_depths]
+        super().__init__(
+            f"all {len(depths)} replicas at capacity "
+            f"(queue depths {depths})",
+            queue_depth=max(depths) if depths else 0,
+            max_queue=max_queue)
+        self.queue_depths = depths
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterSnapshot:
+    """One routing view: ``signals[i]`` is replica *i*'s
+    ``(occupancy, queue_depth, ttft_p50)`` as recovered from gossip,
+    ``scores`` the router's ranking key (lower routes first), ``order``
+    the resulting replica preference, and ``rounds``/``spread`` the
+    gossip's convergence record (0/0.0 for a single replica, which
+    bypasses gossip entirely)."""
+
+    signals: np.ndarray
+    scores: np.ndarray
+    order: tuple
+    rounds: int
+    spread: float
+
+    def as_dict(self) -> Dict[str, List[float]]:
+        return {name: [float(v) for v in self.signals[:, m]]
+                for m, name in enumerate(SIGNAL_NAMES)}
+
+
+class FleetRouter:
+    """Spread requests over ``engines`` by their gossiped gauges.
+
+    Args:
+      engines: the replica :class:`ServingEngine` list.  Each replica
+        should carry its OWN metrics registry (``ServingEngine(...,
+        registry=MetricsRegistry())``) — the router scrapes signals
+        per-replica, and a shared global registry would alias them.
+      registries: the per-replica registries to scrape.  Defaults to
+        each engine's ``metrics`` registry.
+      schedule: gossip topology schedule (anything
+        :class:`FleetAggregator` accepts).  Defaults to the static
+        exponential-two graph over ``len(engines)`` ranks — the same
+        default sparse topology the training side mixes over.  Ignored
+        (no gossip at all) for a single replica.
+      rank: which replica's converged view this router reads and
+        publishes.  Any rank works — convergence makes the views agree
+        to ``tol`` — but a real deployment runs one router per replica,
+        each reading its own rank.
+      registry: where :meth:`publish` lands ``bf_fleet_serving_*``
+        gauges (default: the global registry via the aggregator).
+      weights: score weights for ``(occupancy, queue_depth, ttft_p50)``.
+        Queue depth dominates by default: a queued request waits a full
+        drain, occupancy only predicts the NEXT rejection, and TTFT is
+        a tiebreaker-grade signal (normalized by the fleet max).
+    """
+
+    def __init__(self, engines: Sequence, *,
+                 registries: Optional[Sequence] = None,
+                 schedule=None, rank: int = 0,
+                 tol: float = 1e-13, registry=None,
+                 weights: Sequence[float] = (1.0, 4.0, 0.5)):
+        if not engines:
+            raise ValueError("FleetRouter needs at least one engine")
+        self.engines = list(engines)
+        if registries is None:
+            registries = [e.metrics._registry for e in self.engines]
+            if any(r is None for r in registries):
+                raise ValueError(
+                    "replica engines share the global registry; build "
+                    "each with its own (ServingEngine(..., "
+                    "registry=MetricsRegistry())) or pass registries=")
+        if len(registries) != len(self.engines):
+            raise ValueError(
+                f"{len(registries)} registries for "
+                f"{len(self.engines)} engines")
+        self.registries = list(registries)
+        self.rank = int(rank)
+        if not (0 <= self.rank < len(self.engines)):
+            raise ValueError(f"rank {rank} outside fleet of "
+                             f"{len(self.engines)}")
+        if len(weights) != len(SIGNAL_NAMES):
+            raise ValueError(f"need {len(SIGNAL_NAMES)} score weights")
+        self.weights = tuple(float(w) for w in weights)
+        n = len(self.engines)
+        self._agg = None
+        if n > 1:
+            if schedule is None:
+                from bluefog_tpu.topology import (ExponentialTwoGraph,
+                                                  uniform_topology_spec)
+
+                schedule = uniform_topology_spec(ExponentialTwoGraph(n))
+            self._agg = FleetAggregator(schedule, tol=tol,
+                                        rank=self.rank,
+                                        registry=registry)
+            if self._agg.size != n:
+                raise ValueError(
+                    f"gossip schedule of size {self._agg.size} against "
+                    f"{n} replicas")
+        self._registry = registry
+        self.n_routed = 0
+        self.n_saturated = 0
+
+    # -- gossip --------------------------------------------------------- #
+    def _local_signals(self) -> np.ndarray:
+        rows = [collect_serving_signals(r) for r in self.registries]
+        return np.array([[row[name] for name in SIGNAL_NAMES]
+                         for row in rows], np.float64)
+
+    def poll(self, dead_mask=None) -> RouterSnapshot:
+        """Scrape every replica's local gauges, gossip them through the
+        one-hot block layout, and rank replicas from rank ``rank``'s
+        converged view.  ``dead_mask`` excises replicas exactly the way
+        the training-side gossip excises dead ranks — their signals
+        vanish and their scores come back ``+inf`` (never routed to)."""
+        n, k = len(self.engines), len(SIGNAL_NAMES)
+        local = self._local_signals()
+        if self._agg is None:
+            signals = local
+            rounds, spread = 0, 0.0
+        else:
+            # one-hot block: replica i's row is zero outside block i,
+            # so the converged column means are signal/n_live — exactly
+            # invertible at every rank
+            x = np.zeros((n, n * k))
+            for i in range(n):
+                x[i, i * k:(i + 1) * k] = local[i]
+            agg = self._agg.aggregate(x, dead_mask=dead_mask)
+            n_live = int((~np.isnan(agg.per_rank[:, 0])).sum())
+            view = agg.per_rank[self.rank] * n_live
+            signals = view.reshape(n, k)
+            rounds, spread = agg.rounds, agg.spread
+        dead = (np.zeros(n, bool) if dead_mask is None
+                else np.asarray(dead_mask, bool).reshape(-1))
+        scores = self._score(signals)
+        scores = np.where(dead, np.inf, scores)
+        order = tuple(int(i) for i in np.lexsort(
+            (np.arange(n), scores)))  # score, then index — deterministic
+        return RouterSnapshot(signals=signals, scores=scores,
+                              order=order, rounds=rounds, spread=spread)
+
+    def _score(self, signals: np.ndarray) -> np.ndarray:
+        occ, depth, ttft = (signals[:, 0], signals[:, 1], signals[:, 2])
+        t_max = float(np.max(ttft)) if np.max(ttft) > 0 else 1.0
+        w = self.weights
+        return w[0] * occ + w[1] * depth + w[2] * (ttft / t_max)
+
+    # -- routing -------------------------------------------------------- #
+    def route(self, snapshot: Optional[RouterSnapshot] = None) -> int:
+        """Index of the replica a request should go to next (the head of
+        the snapshot's preference order).  Pass a held snapshot to
+        amortize one gossip over a batch of decisions."""
+        snap = snapshot if snapshot is not None else self.poll()
+        return snap.order[0]
+
+    def submit(self, request,
+               snapshot: Optional[RouterSnapshot] = None):
+        """Submit ``request`` to the best replica, falling through the
+        preference order on per-replica :class:`RequestRejected`
+        backpressure.  Returns ``(replica_index, request)``; raises
+        :class:`FleetSaturated` when the whole fleet refuses."""
+        snap = snapshot if snapshot is not None else self.poll()
+        depths: List[int] = []
+        max_queue = 0
+        for i in snap.order:
+            if not np.isfinite(snap.scores[i]):
+                continue
+            try:
+                self.engines[i].submit(request)
+            except RequestRejected as e:
+                depths.append(e.queue_depth)
+                max_queue = max(max_queue, e.max_queue)
+                continue
+            self.n_routed += 1
+            return i, request
+        self.n_saturated += 1
+        raise FleetSaturated(depths, max_queue)
+
+    # -- observability -------------------------------------------------- #
+    def publish(self, snapshot: Optional[RouterSnapshot] = None
+                ) -> RouterSnapshot:
+        """Land the local view as ``bf_fleet_serving_<signal>[replica]``
+        gauges (plus the routed/saturated counters), so the same
+        Prometheus scrape that serves training fleet metrics shows the
+        serving fleet too."""
+        snap = snapshot if snapshot is not None else self.poll()
+        reg = self._registry
+        if reg is None:
+            from bluefog_tpu.observe import registry as obs_registry
+
+            reg = (obs_registry.get_registry()
+                   if obs_registry.enabled() else None)
+        if reg is not None:
+            for i in range(len(self.engines)):
+                for m, name in enumerate(SIGNAL_NAMES):
+                    v = snap.signals[i, m]
+                    if np.isfinite(v):
+                        reg.gauge(f"bf_fleet_serving_{name}",
+                                  "gossiped replica serving signal",
+                                  replica=str(i)).set(float(v))
+            reg.gauge("bf_fleet_serving_best_replica",
+                      "router's current first choice").set(snap.order[0])
+            reg.counter("bf_fleet_serving_routed_total",
+                        "requests routed").inc(0)
+        return snap
+
+    def summary(self) -> dict:
+        return {
+            "n_replicas": len(self.engines),
+            "n_routed": self.n_routed,
+            "n_saturated": self.n_saturated,
+        }

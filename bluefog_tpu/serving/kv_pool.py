@@ -10,9 +10,16 @@ pattern — which is what keeps the engine free of recompiles.
 
 Allocation is host-side bookkeeping (a free list); the device tree is
 mutated only through the engine's jitted programs.  Freeing a slot
-zeroes it with one jitted donated scatter, so a reused slot starts from
-the exact state a fresh pool has — "slot reuse is invisible" is a
-testable property, not an argument about masked garbage.
+resets its ``cache_index`` leaves (one tiny jitted scatter) — that alone
+makes reuse exact, because everything above the index sits behind the
+causal mask and the next request overwrites positions as it writes them.
+``BLUEFOG_KV_ZERO_ON_FREE=1`` (or ``zero_on_free=True``) additionally
+zeroes the slot's contents: a whole-slot HBM write per retirement that
+buys nothing for correctness (tests assert bit-exactness BOTH ways) but
+makes "reuse leaves no trace" literal — the debugging mode.  It also
+destroys K/V a :class:`~bluefog_tpu.serving.prefix_cache.PrefixCache`
+could have stashed, which is why retention-friendly index-reset is the
+default.
 """
 
 from __future__ import annotations
@@ -22,6 +29,7 @@ from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bluefog_tpu.models.generate import decode_config, init_cache
 from bluefog_tpu.models.llama import LlamaConfig
@@ -33,6 +41,20 @@ __all__ = ["SlotPool"]
 def _zero_slot(pool, slot):
     return jax.tree.map(
         lambda leaf: leaf.at[slot].set(jnp.zeros((), leaf.dtype)), pool)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _reset_index_slot(pool, slot):
+    """Zero only ``slot``'s ``cache_index`` leaves — scalar writes
+    instead of a whole-slot scatter.  The index is the only state a
+    fresh admission observes: K/V above it is causally masked and gets
+    overwritten position by position as the new request prefills."""
+    def fix(path, leaf):
+        if getattr(path[-1], "key", None) == "cache_index":
+            return leaf.at[slot].set(jnp.zeros((), leaf.dtype))
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, pool)
 
 
 class SlotPool:
@@ -48,12 +70,25 @@ class SlotPool:
         for any single request).
       kv_quant: "none" | "int8" — the cache layout
         (``models/generate.py``); int8 halves decode's cache traffic.
+      zero_on_free: ``True`` zeroes a freed slot's whole cache; the
+        default (``None``) follows ``BLUEFOG_KV_ZERO_ON_FREE`` (off —
+        only the ``cache_index`` leaves reset, see module docstring).
+      prefix: an optional
+        :class:`~bluefog_tpu.serving.prefix_cache.PrefixCache` whose
+        ``chunk`` is the engine's prefill chunk; enables
+        :meth:`restore_prefix` / :meth:`stash_chunk`.
     """
 
     def __init__(self, cfg: LlamaConfig, capacity: int, max_len: int,
-                 kv_quant: str = "none"):
+                 kv_quant: str = "none",
+                 zero_on_free: Optional[bool] = None,
+                 prefix=None):
         if capacity < 1:
             raise ValueError(f"capacity ({capacity}) must be >= 1")
+        if zero_on_free is None:
+            from bluefog_tpu import config as bfconfig
+
+            zero_on_free = bfconfig.kv_zero_on_free()
         dcfg = decode_config(cfg, max_len, kv_quant=kv_quant)
         slot_shapes = jax.eval_shape(
             lambda: init_cache(dcfg, 1, max_len, kv_quant=kv_quant))
@@ -63,6 +98,18 @@ class SlotPool:
         self.capacity = capacity
         self.max_len = max_len
         self.kv_quant = kv_quant
+        self.zero_on_free = bool(zero_on_free)
+        self.prefix = prefix
+        self._seq_axes = None
+        if prefix is not None:
+            from bluefog_tpu.serving.prefix_cache import seq_axes
+
+            if max_len % prefix.chunk != 0:
+                raise ValueError(
+                    f"prefix cache chunk ({prefix.chunk}) must divide "
+                    f"max_len ({max_len}) — restores land on the same "
+                    f"chunk grid prefill writes")
+            self._seq_axes = seq_axes(cfg, max_len, kv_quant)
         self._free: List[int] = list(range(capacity - 1, -1, -1))
         self._in_use: set = set()
 
@@ -90,11 +137,53 @@ class SlotPool:
         return slot
 
     def free(self, slot: int) -> None:
-        """Return ``slot`` to the pool and zero its cache (index AND
-        contents), so the next request admitted into it sees exactly the
-        fresh-pool state."""
+        """Return ``slot`` to the pool.  Resets the slot's cache index
+        (always — a stale index would misplace the next request's
+        prefill); zeroes the contents too only under ``zero_on_free``."""
         if slot not in self._in_use:
             raise ValueError(f"slot {slot} is not allocated")
         self._in_use.remove(slot)
         self._free.append(slot)
-        self.cache = _zero_slot(self.cache, jnp.int32(slot))
+        if self.zero_on_free:
+            self.cache = _zero_slot(self.cache, jnp.int32(slot))
+        else:
+            self.cache = _reset_index_slot(self.cache, jnp.int32(slot))
+
+    # -- prefix reuse --------------------------------------------------- #
+    def restore_prefix(self, slot: int, keys,
+                       n: Optional[int] = None) -> int:
+        """Copy the longest cached run of ``keys``'s chunks into
+        ``slot`` (ascending, so ``cache_index`` ends at the restored
+        length) and return how many chunks were restored.  ``n`` caps
+        the run when the caller already matched (the speculative engine
+        restores the MINIMUM of the target/draft matches into both
+        pools).  Each restore is one device copy — the prefill forward
+        it replaces is the savings."""
+        if self.prefix is None:
+            return 0
+        matched = self.prefix.match(keys) if n is None else int(n)
+        for i in range(matched):
+            self._restore_one(slot, keys[i], i * self.prefix.chunk)
+        return matched
+
+    def _restore_one(self, slot: int, key: str, pos: int) -> None:
+        from bluefog_tpu.serving.prefix_cache import _restore_chunk_prog
+
+        self.cache = _restore_chunk_prog(
+            self.cache, jnp.int32(slot), jnp.int32(pos),
+            [jnp.asarray(a) for a in self.prefix.get(key)],
+            axes=self._seq_axes, chunk=self.prefix.chunk)
+
+    def stash_chunk(self, slot: int, key: str, pos: int) -> None:
+        """Pull the chunk at grid position ``pos`` out of ``slot`` and
+        retain it under ``key`` (no-op without a prefix cache).  Called
+        by the engine right after a FULL cold chunk prefills — the K/V
+        is extracted while it provably matches the chain hash."""
+        if self.prefix is None:
+            return
+        from bluefog_tpu.serving.prefix_cache import _extract_chunk_prog
+
+        leaves = _extract_chunk_prog(self.cache, jnp.int32(slot),
+                                     jnp.int32(pos), axes=self._seq_axes,
+                                     chunk=self.prefix.chunk)
+        self.prefix.insert(key, [np.asarray(leaf) for leaf in leaves])

@@ -60,6 +60,10 @@ MODULES = [
     ("bluefog_tpu.serving.engine",
      "continuous-batching serving engine (slot-pooled K/V decode)"),
     ("bluefog_tpu.serving.kv_pool", "fixed-capacity K/V cache slot pool"),
+    ("bluefog_tpu.serving.prefix_cache",
+     "chunk-hashed prefix/KV reuse (host-side LRU of prompt-chunk K/V)"),
+    ("bluefog_tpu.serving.fleet",
+     "gossip-fed multi-replica request router (no central balancer)"),
     ("bluefog_tpu.serving.scheduler",
      "FIFO admission, deadlines, backpressure"),
     ("bluefog_tpu.serving.metrics",

@@ -78,3 +78,77 @@ def test_bench_py_defaults_to_committed_baseline():
     assert os.path.exists(args.compare)
     assert bench.parse_args(["--compare", ""]).compare is None
     assert bench.parse_args(["--compare", "x.json"]).compare == "x.json"
+
+
+# --------------------------------------------------------------------- #
+# serving + fleet-serving baselines (ISSUE 9): the two serving benches
+# gate against committed records by default, same flow as bench.py
+# --------------------------------------------------------------------- #
+def _load_bench_module(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "benchmarks", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serving_bench_defaults_to_committed_baseline():
+    """serving_bench.py gates against benchmarks/serving_baseline.json
+    (the committed r07 record) by default; ``--compare ''`` opts out."""
+    sb = _load_bench_module("serving_bench")
+    args = sb.parse_args([])
+    assert args.compare == sb.DEFAULT_BASELINE
+    assert os.path.exists(args.compare)
+    assert sb.parse_args(["--compare", ""]).compare is None
+    assert sb.parse_args(["--compare", "x.json"]).compare == "x.json"
+
+
+def test_serving_baseline_is_the_r07_record():
+    base = _load(os.path.join("benchmarks", "serving_baseline.json"))
+    r07 = _load("serving_bench_r07.json")
+    assert base == r07
+    assert base["continuous"]["tokens_per_sec"] > 0
+    # the gate sees the serving headline fields
+    from bluefog_tpu.benchutil import bench_headline
+
+    head = bench_headline(base)
+    assert "continuous.tokens_per_sec" in head
+    assert "continuous.ttft_p50" in head
+
+
+def test_fleet_serving_defaults_and_baseline():
+    """fleet_serving.py follows the same gate flow, and its committed
+    baseline passed every machine-checked claim."""
+    fs = _load_bench_module("fleet_serving")
+    args = fs.parse_args([])
+    assert args.compare == fs.DEFAULT_BASELINE
+    assert os.path.exists(args.compare)
+    assert fs.parse_args(["--compare", ""]).compare is None
+    base = _load(os.path.join("benchmarks",
+                              "fleet_serving_baseline.json"))
+    assert all(base["machine_checked"].values())
+    assert base["fleet_two"]["fleet_speedup"] > 1.0
+    from bluefog_tpu.benchutil import bench_headline
+
+    head = bench_headline(base)
+    assert "fleet_two.fleet_speedup" in head
+    assert "prefix.hit_rate" in head
+    assert "speculative.accepted_per_step" in head
+
+
+def test_gate_catches_fleet_regression(capsys):
+    """A collapsed fleet speedup / prefix hit rate fails the gate."""
+    from bluefog_tpu.benchutil import bench_compare
+
+    base = _load(os.path.join("benchmarks",
+                              "fleet_serving_baseline.json"))
+    regressed = copy.deepcopy(base)
+    regressed["fleet_two"]["fleet_speedup"] = 1.0
+    regressed["prefix"]["hit_rate"] *= 0.5
+    ok, rows = bench_compare(regressed, base, tolerance=0.25)
+    assert ok is False
+    bad = {r["name"] for r in rows if r["regressed"]}
+    assert "fleet_two.fleet_speedup" in bad
+    assert "prefix.hit_rate" in bad

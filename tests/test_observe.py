@@ -455,7 +455,8 @@ def test_train_step_publishes_and_opt_out(monkeypatch):
 
 def test_serving_metrics_publish_and_opt_out(monkeypatch):
     """ServingMetrics rides the registry (isolated here via registry=)
-    and the summary dict keeps its original shape; with
+    and the summary dict keeps a pinned key set (the original shape plus
+    the fleet-serving prefix/speculative counters); with
     BLUEFOG_OBSERVE=0 and no explicit registry nothing is published."""
     from bluefog_tpu.serving.metrics import ServingMetrics
 
@@ -481,7 +482,9 @@ def test_serving_metrics_publish_and_opt_out(monkeypatch):
         "n_requests", "n_finished", "n_rejected", "outcomes",
         "tokens_generated", "tokens_per_sec", "ttft_p50", "ttft_p99",
         "latency_p50", "latency_p99", "mean_slot_occupancy",
-        "mean_queue_depth", "max_queue_depth"}
+        "mean_queue_depth", "max_queue_depth", "prefill_chunks",
+        "prefix_chunks_restored", "prefix_tokens_restored",
+        "prefix_hit_rate", "spec_steps", "accepted_per_step"}
 
     monkeypatch.setenv("BLUEFOG_OBSERVE", "0")
     global_before = observe.get_registry().snapshot()
@@ -689,8 +692,9 @@ def test_jsonl_and_snapshot(tmp_path):
 
 
 def test_engine_profile_emits_step_profiles():
-    """ServingEngine.profile(): HLO-attributed StepProfiles of the two
-    resident programs, FLOPs from XLA's own cost analysis."""
+    """ServingEngine.profile(): HLO-attributed StepProfiles of every
+    resident program (two for a plain engine), enumerated from the
+    build-time registry, FLOPs from XLA's own cost analysis."""
     from bluefog_tpu import models
     from bluefog_tpu.serving import ServingEngine
 

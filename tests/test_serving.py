@@ -459,7 +459,7 @@ def test_serving_bench_smoke(tmp_path):
          "--num-requests", "6", "--rate", "4", "--capacity", "2",
          "--max-len", "48", "--prompt-len", "3", "8",
          "--new-tokens", "2", "6", "--dim", "64", "--layers", "2",
-         "--prefill-chunk", "4", "--out", out],
+         "--prefill-chunk", "4", "--out", out, "--compare", ""],
         capture_output=True, text=True, timeout=600, env=env, cwd=repo)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     rec = json.load(open(out))
