@@ -29,6 +29,23 @@ Three parts, one JSON artifact (wire_quant_consensus_r05.json style):
    stall — what each process would gossip in a real fleet) feeds the
    ``StragglerDetector`` through ``run_resilient``.  Reported: the
    flag step, detection latency vs the bound, z-scores, false flags.
+4. **Preempt -> rejoin cycle** (round 13 / ISSUE 10): elastic
+   membership in both layers.  Simulation (n=32, pure numpy): preempt
+   two ranks, converge the survivors on the healed schedule, admit
+   both back through the annealed quarantined bootstrap
+   (``MembershipController.mixing_matrices``), promote, and verify
+   the re-GROWN tables are byte-equal to the pristine plan and the
+   FULL 32-rank consensus floor recovers to <= 1e-12.  End to end
+   (8 CPU 'ranks'): ``run_resilient(elastic=...)`` drives a
+   ``FaultPlan.preempt`` through death, heal, rollback, admission,
+   anneal, and promotion on the ONE compiled program — recompiles
+   must be 0 and the fleet must end fully live, with the p50 step
+   throughput after the promotion recovering to the pre-fault rate.
+
+The JSON artifact doubles as the bench-gate baseline: ``--compare``
+defaults to the committed ``chaos_resilience_r13.json`` (pass ``''``
+to disable) and gates the rejoin headline metrics before overwriting
+``--out`` — the rolling-baseline discipline of serving_bench.py.
 
 Run (CPU, no TPU): JAX_PLATFORMS=cpu python benchmarks/chaos_resilience.py
 """
@@ -267,19 +284,205 @@ def straggler_scenario(steps: int, seed: int) -> dict:
     }
 
 
-def main():
+def rejoin_sim(sim_rounds: int, dim: int, seed: int) -> dict:
+    """Part 4a: the preempt -> rejoin cycle in the n=32 mixing
+    simulation — healed floor, quarantined bootstrap, byte-equal
+    growth, recovered FULL-fleet floor."""
+    from bluefog_tpu.elastic import MembershipController, disagreement
+    from bluefog_tpu.resilience import heal_weights
+    from bluefog_tpu.topology import one_peer_dynamic_schedule
+
+    preempted = [3, 17]
+    sched = one_peer_dynamic_schedule(SIM_N)
+    mc = MembershipController(sched, bootstrap_rounds=8)
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((SIM_N, dim))
+    d0 = float(np.linalg.norm(x - x.mean(axis=0)))
+    t = 0
+
+    def mix(rounds, tick=False):
+        nonlocal x, t
+        for _ in range(rounds):
+            M = mc.mixing_matrices()[t % len(sched)]
+            x = M @ x
+            t += 1
+            if tick:
+                mc.tick()
+
+    def floor(mask):
+        sub = x[mask]
+        return float(np.linalg.norm(sub - sub.mean(axis=0))) / d0
+
+    live = np.ones(SIM_N, bool)
+    mix(sim_rounds)
+    healthy_floor = floor(live)
+    # preempt: the two ranks die with drifted state; survivors heal
+    mc.mark_dead(preempted)
+    x[preempted] += rng.standard_normal((len(preempted), dim))
+    live[preempted] = False
+    mix(sim_rounds)
+    healed_floor = floor(live)
+    # rejoin: annealed quarantine pull, then the promotion gate
+    mc.admit(preempted)
+    mix(sim_rounds, tick=True)
+    dis = {str(r): float(disagreement({"x": x}, r, mc.live_mask()))
+           for r in preempted}
+    mc.promote(preempted)
+    grow_byte_equal = all(
+        cw.tobytes() == pcw.tobytes() and sw.tobytes() == psw.tobytes()
+        for (cw, sw), (pcw, psw) in zip(
+            mc.comm_weight_arrays(),
+            (heal_weights(s, np.zeros(SIM_N, bool)) for s in sched)))
+    live[preempted] = True
+    mix(sim_rounds)
+    return {
+        "n": SIM_N, "preempted_ranks": preempted,
+        "rounds_per_phase": sim_rounds,
+        "healthy_floor": healthy_floor,
+        "healed_floor": healed_floor,
+        "promote_disagreement": dis,
+        "grow_byte_equal": bool(grow_byte_equal),
+        "post_rejoin_floor": floor(live),
+    }
+
+
+def rejoin_cycle(steps: int, sim_rounds: int, dim: int, seed: int) -> dict:
+    """Part 4: preempt -> heal -> bootstrap -> rejoin, both layers."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    from bluefog_tpu import resilience as R
+    from bluefog_tpu.checkpoint import Checkpointer
+    from bluefog_tpu.elastic import ElasticConfig
+    from bluefog_tpu.optim import functional as F
+    from bluefog_tpu.topology import one_peer_dynamic_schedule
+
+    sim = rejoin_sim(sim_rounds, dim, seed)
+
+    mesh = Mesh(np.array(jax.devices()[:N]), ("bf",))
+    sched = one_peer_dynamic_schedule(N)
+    pdim, width = 16, 4
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(pdim, width)
+    xs = rng.randn(64, N, 8, pdim)
+    ys = xs @ w_true + 0.01 * rng.randn(64, N, 8, width)
+
+    # batch_fn timestamps are the per-step clock: successive calls
+    # bracket exactly one executed step (replays included), so the
+    # pre-fault vs post-promotion p50 comes out of the run itself
+    calls = []
+
+    def batch_fn(step):
+        calls.append((step, time.monotonic()))
+        return (xs[step % 64], ys[step % 64])
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    opt = optax.sgd(0.05, momentum=0.9)
+    step_g = F.build_train_step(loss_fn, opt, mesh, comm_mode="atc",
+                                schedule=sched, guard=F.GuardConfig())
+    params = F.rank_major({"w": jnp.zeros((pdim, width))}, mesh)
+    opt_state = F.rank_major(opt.init({"w": jnp.zeros((pdim, width))}),
+                             mesh)
+
+    preempt_at = max(4, steps // 5)
+    duration = max(4, steps // 5)
+    plan = R.FaultPlan.preempt(N, rank=2, step=preempt_at,
+                               duration=duration)
+    import tempfile
+
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory() as d:
+        ck = Checkpointer(d)
+        res = R.run_resilient(
+            step_g, params, opt_state, batch_fn, steps=steps,
+            checkpointer=ck, mesh=mesh, schedule=sched,
+            guard=F.GuardConfig(max_consecutive_bad=3, backoff_base=0.0),
+            fault_plan=plan, checkpoint_every=max(2, steps // 6),
+            sleep=lambda s: None,
+            elastic=ElasticConfig(bootstrap_rounds=6,
+                                  max_quarantine_steps=24))
+        ck.close()
+    wall_s = time.monotonic() - t0
+
+    promos = [e for e in res.events if e.kind == "rank_promoted"]
+    promote_step = promos[0].step if promos else None
+    # p50 step seconds before the fault vs after the promotion (step 0
+    # carries the compile and is excluded)
+    durs = [(calls[i][0], calls[i + 1][1] - calls[i][1])
+            for i in range(len(calls) - 1)]
+    pre = [d for s, d in durs if 1 <= s < preempt_at]
+    post = ([d for s, d in durs if s > promote_step]
+            if promote_step is not None else [])
+    p50_pre = float(np.median(pre)) if pre else float("nan")
+    p50_post = float(np.median(post)) if post else float("nan")
+    recovery = (p50_pre / p50_post
+                if post and p50_post > 0 else 0.0)
+    return {
+        "steps": steps,
+        "preempt": {"rank": 2, "step": preempt_at,
+                    "duration": duration},
+        "events": [(e.kind, e.step) for e in res.events
+                   if e.kind != "skip"],
+        "n_rollbacks": res.n_rollbacks,
+        "recompiles": step_g.jitted._cache_size() - 1,
+        "promote_step": promote_step,
+        "promote_disagreement": (
+            float(promos[0].detail["disagreement"]) if promos else None),
+        "final_membership_all_live": (
+            res.membership == ["live"] * N and not res.dead_mask.any()),
+        "p50_step_s_prefault": p50_pre,
+        "p50_step_s_postpromote": p50_post,
+        "throughput_recovery": recovery,
+        "wall_s": wall_s,
+        "sim": sim,
+        # hoisted for the bench-gate headline grab (section scan is
+        # one level deep)
+        "post_rejoin_floor": sim["post_rejoin_floor"],
+    }
+
+
+DEFAULT_BASELINE = "benchmarks/chaos_resilience_r13.json"
+
+
+def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--dim", type=int, default=256,
                     help="payload width of the mixing simulation")
     ap.add_argument("--sim-rounds", type=int, default=400)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--out", default="benchmarks/chaos_resilience_r10.json")
-    args = ap.parse_args()
+    ap.add_argument("--out", default=DEFAULT_BASELINE)
+    ap.add_argument("--compare", metavar="PREV.json",
+                    default=(DEFAULT_BASELINE
+                             if os.path.exists(DEFAULT_BASELINE)
+                             else None),
+                    help="regression gate (default: the committed "
+                         "chaos_resilience_r13.json when present; "
+                         "pass '' to disable)")
+    ap.add_argument("--tolerance", type=float, default=0.5,
+                    help="gate tolerance (loose: the throughput-"
+                         "recovery ratio rides this host's wall "
+                         "clock; the consensus floors are seeded "
+                         "and deterministic)")
+    args = ap.parse_args(argv)
+    if args.compare == "":
+        args.compare = None
+    return args
+
+
+def main():
+    args = parse_args()
 
     sim = simulate(args.sim_rounds, args.dim, args.seed)
     chaos = chaos_run(args.steps, args.seed)
     strag = straggler_scenario(args.steps, args.seed)
+    rejoin = rejoin_cycle(args.steps, min(args.sim_rounds, 120),
+                          args.dim, args.seed)
 
     checks = {
         # healing keeps the surviving ranks contracting...
@@ -307,6 +510,20 @@ def main():
             <= strag["detection_bound_steps"]),
         "straggler_feeds_suspects": (
             strag["failure_detector_suspects"] == [strag["slow_rank"]]),
+        # the preempted rank came BACK: grown tables byte-equal to the
+        # pristine plan, full-fleet consensus floor recovered, the
+        # whole cycle on one compiled program, and the post-promotion
+        # step rate back in the pre-fault regime
+        "rejoin_grow_byte_equal": rejoin["sim"]["grow_byte_equal"],
+        "rejoin_consensus_floor": (
+            rejoin["sim"]["post_rejoin_floor"] <= 1e-12),
+        "rejoin_zero_recompiles": rejoin["recompiles"] == 0,
+        "rejoin_all_live": rejoin["final_membership_all_live"],
+        "rejoin_promoted_inside_cloud": (
+            rejoin["promote_disagreement"] is not None
+            and rejoin["promote_disagreement"] <= 1.0),
+        "rejoin_throughput_recovers": (
+            rejoin["throughput_recovery"] >= 0.5),
     }
     for k, ok in checks.items():
         print(f"[check] {k}: {'OK' if ok else 'FAILED'}")
@@ -315,12 +532,24 @@ def main():
         "simulation": sim,
         "chaos": chaos,
         "straggler": strag,
+        "rejoin": rejoin,
         "checks": {k: bool(v) for k, v in checks.items()},
     }
+    print(json.dumps({"checks": out["checks"]}))
+    if not all(checks.values()):
+        return 1
+    # gate BEFORE writing --out (rolling-baseline discipline, same as
+    # serving_bench.py / fleet_serving.py)
+    if args.compare:
+        from bluefog_tpu.benchutil import bench_regression_gate
+
+        if not bench_regression_gate(out, args.compare,
+                                     tolerance=args.tolerance):
+            print(f"[bench-gate] regression: NOT writing {args.out}")
+            return 1
     with open(args.out, "w") as fh:
         json.dump(out, fh, indent=1)
-    print(json.dumps({"checks": out["checks"]}))
-    return 0 if all(checks.values()) else 1
+    return 0
 
 
 if __name__ == "__main__":

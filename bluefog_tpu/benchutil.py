@@ -681,9 +681,11 @@ def fwd_bwd_time(f, params, x0, n=20, reps=3):
 _HEADLINE_HIGHER = ("value", "mfu", "tokens_per_sec", "useful_tokens",
                     "speedup_tokens_per_sec", "vs_baseline",
                     "compiled_advantage", "hit_rate",
-                    "accepted_per_step", "fleet_speedup")
+                    "accepted_per_step", "fleet_speedup",
+                    "throughput_recovery")
 _HEADLINE_LOWER = ("ttft_p50", "ttft_p99", "latency_p50", "latency_p99",
-                   "makespan_s", "p99", "p50", "cost_to_consensus")
+                   "makespan_s", "p99", "p50", "cost_to_consensus",
+                   "post_rejoin_floor")
 
 
 def bench_headline(record: dict) -> dict:
@@ -706,8 +708,8 @@ def bench_headline(record: dict) -> dict:
 
     grab(record, "")
     for section in ("continuous", "static", "chaos", "straggler",
-                    "pod_4x8", "pod_8x16", "fleet_one", "fleet_two",
-                    "prefix", "speculative"):
+                    "rejoin", "pod_4x8", "pod_8x16", "fleet_one",
+                    "fleet_two", "prefix", "speculative"):
         if isinstance(record.get(section), dict):
             grab(record[section], section + ".")
     return out

@@ -26,6 +26,8 @@ __all__ = [
     "fusion_threshold",
     "kv_zero_on_free",
     "prefix_cache_mb",
+    "elastic_bootstrap_rounds",
+    "elastic_quarantine_threshold",
 ]
 
 
@@ -128,6 +130,32 @@ def prefix_cache_mb() -> int:
         return int(_env("BLUEFOG_PREFIX_CACHE_MB", "64"))
     except ValueError:
         return 64
+
+
+def elastic_bootstrap_rounds() -> int:
+    """BLUEFOG_ELASTIC_BOOTSTRAP_ROUNDS (default 8): quarantined mixing
+    rounds a joining rank's self-weight anneals over (0 -> its pristine
+    weight) while bootstrapping by pulled neighbor averaging
+    (:mod:`bluefog_tpu.elastic.bootstrap`).  More rounds = gentler
+    re-entry; the first round is always a pure pull regardless."""
+    try:
+        return max(1, int(_env("BLUEFOG_ELASTIC_BOOTSTRAP_ROUNDS", "8")))
+    except ValueError:
+        return 8
+
+
+def elastic_quarantine_threshold() -> float:
+    """BLUEFOG_ELASTIC_QUARANTINE_THRESHOLD (default 1.0): max
+    normalized bootstrap disagreement (joiner's L2 distance from the
+    live mean, in units of the live ranks' own max deviation — see
+    :func:`bluefog_tpu.elastic.bootstrap.disagreement`) for promotion
+    to LIVE.  <= 1.0 means the joiner sits inside the live consensus
+    cloud.  Until it clears, live receivers keep zero weight on the
+    joiner — a half-synced value never leaks into the fleet."""
+    try:
+        return float(_env("BLUEFOG_ELASTIC_QUARANTINE_THRESHOLD", "1.0"))
+    except ValueError:
+        return 1.0
 
 
 def fusion_threshold() -> int:

@@ -42,6 +42,7 @@ Aggregated values land back in the local
 from __future__ import annotations
 
 import dataclasses
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -66,6 +67,26 @@ __all__ = [
 ]
 
 _EDGE_BYTES_HELP = "per-edge neighbor-exchange payload (logical bytes)"
+
+# the aggregator's per-dead-mask matrix cache is LRU-bounded: elastic
+# membership churns the mask in BOTH directions (die -> heal -> rejoin
+# -> grow), and an unbounded dict would retain every pattern ever seen
+_MATS_CACHE_MAX = 32
+
+
+def _resolve_dead_mask(dead_mask, size: int) -> np.ndarray:
+    """Normalize a dead-mask argument: ``None`` (nobody dead), a bool
+    array, or any object with an ``effective_dead_mask()`` method — the
+    duck-typed hook a ``bluefog_tpu.elastic.MembershipController``
+    satisfies, so the gossip layer heals and RE-GROWS in lockstep with
+    the data plane's membership (a JOINING rank is still excised: it is
+    not yet read from)."""
+    if dead_mask is None:
+        return np.zeros(size, bool)
+    eff = getattr(dead_mask, "effective_dead_mask", None)
+    if callable(eff):
+        dead_mask = eff()
+    return np.asarray(dead_mask, bool).reshape(-1)
 
 
 def edge_list(spec: CommSpec) -> List[tuple]:
@@ -220,18 +241,31 @@ class FleetAggregator:
         self._registry = registry
         self.record_traffic = record_traffic
         # matrices cache: keyed by dead-mask bytes (flat gossip) or
-        # (machine-schedule digests, machine-dead bytes) (hierarchical)
-        self._mats: Dict[object, list] = {}
+        # (machine-schedule digests, machine-dead bytes) (hierarchical);
+        # LRU-bounded — elastic membership churns the mask both ways
+        self._mats: "OrderedDict[object, list]" = OrderedDict()
 
     # ------------------------------------------------------------- #
     # gossip core
     # ------------------------------------------------------------- #
+    def _cache_put(self, key, mats: list) -> None:
+        self._mats[key] = mats
+        self._mats.move_to_end(key)
+        while len(self._mats) > _MATS_CACHE_MAX:
+            self._mats.popitem(last=False)
+
+    def _cache_get(self, key):
+        mats = self._mats.get(key)
+        if mats is not None:
+            self._mats.move_to_end(key)
+        return mats
+
     def _matrices(self, dead: np.ndarray) -> list:
         key = dead.tobytes()
-        mats = self._mats.get(key)
+        mats = self._cache_get(key)
         if mats is None:
             mats = [push_sum_matrix(s, dead) for s in self.schedule]
-            self._mats[key] = mats
+            self._cache_put(key, mats)
         return mats
 
     @staticmethod
@@ -287,8 +321,11 @@ class FleetAggregator:
         """Gossip ``values`` (``[n, k]`` rank-major, or ``[n]`` for one
         metric) to every live rank's estimate of the live mean.
 
-        Dead ranks (``dead_mask``) contribute nothing and receive
-        nothing — their rows come back NaN; this matches a
+        Dead ranks (``dead_mask`` — a bool mask, or a
+        ``bluefog_tpu.elastic.MembershipController`` whose
+        ``effective_dead_mask()`` is read live, so gossip shrinks AND
+        grows with the data plane's membership) contribute nothing and
+        receive nothing — their rows come back NaN; this matches a
         ``healing.heal_spec``-re-planned schedule exactly (the test
         asserts matrix equality).  A healed schedule passed WITHOUT a
         dead mask works too: ranks the re-plan fully excised (no edges
@@ -304,8 +341,7 @@ class FleetAggregator:
         k = x.shape[1]
         names = tuple(names) if names is not None else tuple(
             f"m{j}" for j in range(k))
-        dead = (np.zeros(self.size, bool) if dead_mask is None
-                else np.asarray(dead_mask, bool).reshape(-1))
+        dead = _resolve_dead_mask(dead_mask, self.size)
         if not (~dead).any():
             raise ValueError("no live ranks to aggregate over")
         dead, mats = self._fold_isolated(self._matrices(dead), dead,
@@ -342,8 +378,7 @@ class FleetAggregator:
         n, k = x.shape
         names = tuple(names) if names is not None else tuple(
             f"m{j}" for j in range(k))
-        dead = (np.zeros(n, bool) if dead_mask is None
-                else np.asarray(dead_mask, bool).reshape(-1))
+        dead = _resolve_dead_mask(dead_mask, n)
         live = ~dead
         groups = machine_groups(n, local_size)
         if isinstance(machine_schedule, (Topology, DynamicTopology)):
@@ -368,10 +403,10 @@ class FleetAggregator:
         def machine_mats(md: np.ndarray) -> list:
             mkey = (tuple(s.digest() for s in machine_schedule),
                     md.tobytes())
-            mats = self._mats.get(mkey)
+            mats = self._cache_get(mkey)
             if mats is None:
                 mats = [push_sum_matrix(s, md) for s in machine_schedule]
-                self._mats[mkey] = mats
+                self._cache_put(mkey, mats)
             return mats
 
         mdead, mats = self._fold_isolated(machine_mats(mdead), mdead,

@@ -22,7 +22,10 @@ jitted-program *inputs*, never shapes, so nothing ever recompiles:
   ``Checkpointer``.
 
 The jitted half lives in ``optim.functional``:
-``build_train_step(..., guard=GuardConfig(...))``.  Guide:
+``build_train_step(..., guard=GuardConfig(...))``.  The GROWTH
+direction of the lifecycle — ranks that join back, with quarantined
+bootstrap and the exact inverse of healing — is the sibling package
+:mod:`bluefog_tpu.elastic` (``run_resilient(elastic=...)``).  Guide:
 docs/resilience.md.
 """
 
@@ -33,6 +36,7 @@ from bluefog_tpu.optim.functional import (  # noqa: F401
 from bluefog_tpu.resilience.faults import (  # noqa: F401
     Fault,
     FaultPlan,
+    PREEMPT,
 )
 from bluefog_tpu.resilience.detector import (  # noqa: F401
     FailureDetector,
@@ -45,6 +49,7 @@ from bluefog_tpu.resilience.healing import (  # noqa: F401
     healed_comm_weights,
     is_row_stochastic,
     mixing_matrix,
+    mixing_matrix_from_weights,
     row_sums,
 )
 from bluefog_tpu.resilience.runner import (  # noqa: F401
@@ -52,12 +57,17 @@ from bluefog_tpu.resilience.runner import (  # noqa: F401
     ResilientResult,
     run_resilient,
 )
+# the growth direction of the lifecycle rides run_resilient(elastic=...),
+# so its config is part of this package's surface too
+from bluefog_tpu.elastic.membership import ElasticConfig  # noqa: F401
 
 __all__ = [
+    "ElasticConfig",
     "GuardConfig",
     "comm_weight_inputs",
     "Fault",
     "FaultPlan",
+    "PREEMPT",
     "FailureDetector",
     "update_health",
     "consensus_simulation",
@@ -66,6 +76,7 @@ __all__ = [
     "healed_comm_weights",
     "is_row_stochastic",
     "mixing_matrix",
+    "mixing_matrix_from_weights",
     "row_sums",
     "ResilienceEvent",
     "ResilientResult",

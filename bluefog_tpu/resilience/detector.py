@@ -59,10 +59,14 @@ class FailureDetector:
 
     ``observe`` one rank-major skip vector per step; ``suspects(k)``
     names ranks with >= k CONSECUTIVE skips that have not already been
-    declared dead; ``declare_dead`` commits a verdict (monotonic — death
-    is never rescinded; a healed topology has no path back for a rank
-    whose state diverged).  ``dead_mask`` is the boolean mask topology
-    healing takes."""
+    declared dead; ``declare_dead`` commits a verdict.  Death is not
+    rescinded by recovery — a healed topology has no path back for a
+    rank whose state silently diverged — but it IS reversible through
+    the elastic membership lifecycle: ``readmit`` (called by
+    ``MembershipController.promote`` once a rejoining rank's
+    bootstrapped state has re-converged) clears the verdict along with
+    the latched streak/suspicion that would instantly re-excise the
+    rank.  ``dead_mask`` is the boolean mask topology healing takes."""
 
     def __init__(self, size: int):
         if size < 1:
@@ -157,6 +161,31 @@ class FailureDetector:
             if not 0 <= r < self.size:
                 raise ValueError(f"rank {r} outside world {self.size}")
             self._dead[r] = True
+
+    def readmit(self, ranks: Sequence[int]) -> None:
+        """Reverse a death verdict for ranks the elastic membership
+        lifecycle has re-bootstrapped (``MembershipController.promote``
+        calls this once quarantine disagreement clears the threshold).
+
+        Clearing the dead flag alone would NOT be enough: the
+        consecutive-skip streak kept counting while the rank was dead
+        (``observe`` has no dead special-case) and external suspicion
+        latches until its source withdraws it — either one would make
+        ``suspects()`` re-excise the rank on its first live step.  So
+        readmission also zeroes the streak and drops every source's
+        external claim.  ``total_skips`` is history, not suspicion, and
+        is kept."""
+        for r in ranks:
+            if not 0 <= r < self.size:
+                raise ValueError(f"rank {r} outside world {self.size}")
+            if not self._dead[r]:
+                raise ValueError(
+                    f"rank {r} is not dead — nothing to readmit")
+        for r in ranks:
+            r = int(r)
+            self._dead[r] = False
+            self._consecutive[r] = 0
+            self._external.pop(r, None)
 
     def dead_mask(self) -> np.ndarray:
         return self._dead.copy()

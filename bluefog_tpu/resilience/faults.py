@@ -24,10 +24,10 @@ from typing import Any, List, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["Fault", "FaultPlan", "NAN", "INF", "DEAD", "STALL"]
+__all__ = ["Fault", "FaultPlan", "NAN", "INF", "DEAD", "STALL", "PREEMPT"]
 
-NAN, INF, DEAD, STALL = "nan", "inf", "dead", "stall"
-_KINDS = (NAN, INF, DEAD, STALL)
+NAN, INF, DEAD, STALL, PREEMPT = "nan", "inf", "dead", "stall", "preempt"
+_KINDS = (NAN, INF, DEAD, STALL, PREEMPT)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,11 +35,16 @@ class Fault:
     """One scheduled fault.
 
     ``step``: first step the fault is active.  ``duration``: steps a
-    nan/inf burst — or a ``stall`` — lasts (ignored for ``dead``,
-    which is permanent).  ``stall_seconds``: host-loop sleep injected
-    PER ACTIVE STEP by a ``stall`` fault (exercises the watchdog / op
-    timeout / straggler detector, not the numerics); a multi-step
-    stall on one rank is the injected-straggler scenario."""
+    nan/inf burst — or a ``stall``, or a ``preempt`` — lasts (ignored
+    for ``dead``, which is permanent).  A ``preempt`` is
+    duration-limited death: the rank emits NaN like a dead rank for
+    ``[step, step + duration)`` and computes healthily again after —
+    the deterministic, replayable input of a preempt -> rejoin cycle
+    (elastic membership; the returning rank is ``rejoinable_ranks``'s
+    answer, not automatically live).  ``stall_seconds``: host-loop
+    sleep injected PER ACTIVE STEP by a ``stall`` fault (exercises the
+    watchdog / op timeout / straggler detector, not the numerics); a
+    multi-step stall on one rank is the injected-straggler scenario."""
 
     step: int
     rank: int
@@ -107,6 +112,19 @@ class FaultPlan:
         return FaultPlan(size, [Fault(step, rank, STALL, duration,
                                       stall_seconds=stall_seconds)])
 
+    @staticmethod
+    def preempt(size: int, rank: int, step: int,
+                duration: int) -> "FaultPlan":
+        """Duration-limited death: ``rank`` is a NaN emitter for
+        ``[step, step + duration)`` and healthy after — a preemptible
+        host losing and regaining its slot.  Pick ``duration`` past the
+        guard's death threshold so the detector actually declares the
+        rank dead mid-window; once the window ends the rank shows up in
+        :meth:`rejoinable_ranks`, which is the default admission signal
+        of ``run_resilient(elastic=...)`` — the full preempt -> heal ->
+        bootstrap -> rejoin cycle from one deterministic plan."""
+        return FaultPlan(size, [Fault(step, rank, PREEMPT, duration)])
+
     def merged(self, other: "FaultPlan") -> "FaultPlan":
         if other.size != self.size:
             raise ValueError("cannot merge plans over different sizes")
@@ -132,7 +150,7 @@ class FaultPlan:
         2 Inf.  Dead ranks read as 1 (permanent NaN emitters)."""
         codes = np.zeros((self.size,), np.int8)
         for f in self.active(step):
-            if f.kind in (NAN, DEAD):
+            if f.kind in (NAN, DEAD, PREEMPT):
                 codes[f.rank] = 1
             elif f.kind == INF:
                 codes[f.rank] = 2
@@ -141,6 +159,24 @@ class FaultPlan:
     def dead_ranks(self, step: int) -> List[int]:
         return sorted({f.rank for f in self.faults
                        if f.kind == DEAD and step >= f.step})
+
+    def preempted_ranks(self, step: int) -> List[int]:
+        """Ranks inside an active preempt window at ``step`` — dead for
+        now, but scheduled to come back."""
+        return sorted({f.rank for f in self.active(step)
+                       if f.kind == PREEMPT})
+
+    def rejoinable_ranks(self, step: int) -> List[int]:
+        """Ranks whose preempt window has ENDED by ``step`` and that no
+        other fault currently holds — the deterministic admission
+        signal for elastic membership (``run_resilient(elastic=...)``
+        polls this when no explicit ``admit`` callable is given).  A
+        rank re-preempted by a later fault drops out again until that
+        window too has passed."""
+        ended = {f.rank for f in self.faults
+                 if f.kind == PREEMPT and step >= f.step + f.duration}
+        held = {f.rank for f in self.active(step)}
+        return sorted(ended - held)
 
     def stall_seconds(self, step: int) -> float:
         return float(sum(f.stall_seconds for f in self.active(step)

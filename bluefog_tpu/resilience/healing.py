@@ -44,6 +44,7 @@ CommSpec = Union[Topology, DynamicTopology]
 
 __all__ = [
     "mixing_matrix",
+    "mixing_matrix_from_weights",
     "row_sums",
     "is_row_stochastic",
     "heal_weights",
@@ -67,6 +68,30 @@ def mixing_matrix(spec: CommSpec) -> np.ndarray:
         for (src, dst) in cls.perm:
             if cls.recv_weights[dst] != 0.0:
                 M[dst, src] += cls.recv_weights[dst]
+    return M
+
+
+def mixing_matrix_from_weights(spec: CommSpec, class_weights,
+                               self_weights) -> np.ndarray:
+    """The receiver-major mixing matrix a ``(class_weights [n_classes,
+    n], self_weights [n])`` table pair induces over ``spec``'s edge
+    structure — the numpy view of exactly what a compiled step does
+    with re-planned weight DATA (healed, grown, or bootstrap-annealed),
+    for simulation and row-sum audits."""
+    n = spec.size
+    cw = np.asarray(class_weights, np.float64)
+    sw = np.asarray(self_weights, np.float64).reshape(-1)
+    classes = spec.shift_classes
+    if cw.shape != (len(classes), n) or sw.shape[0] != n:
+        raise ValueError(
+            f"weight tables of shapes {cw.shape}/{sw.shape} do not "
+            f"match {len(classes)} classes over size {n}")
+    M = np.zeros((n, n), np.float64)
+    M[np.arange(n), np.arange(n)] = sw
+    for c, cls in enumerate(classes):
+        for (src, dst) in cls.perm:
+            if cw[c, dst] != 0.0:
+                M[dst, src] += cw[c, dst]
     return M
 
 
@@ -160,7 +185,7 @@ def healed_comm_weights(specs: Sequence[CommSpec], dead_mask) -> tuple:
 
 def consensus_simulation(specs: Sequence[CommSpec], rounds: int,
                          dim: int = 32, seed: int = 0,
-                         dead_mask=None) -> np.ndarray:
+                         dead_mask=None, weights=None) -> np.ndarray:
     """Seeded consensus-distance trace of iterated mixing (the
     wire_quant_consensus harness's pure-numpy machinery, pointed at
     healing): iterate ``x <- M_t @ x`` over the schedule and report,
@@ -173,7 +198,13 @@ def consensus_simulation(specs: Sequence[CommSpec], rounds: int,
     zero and the survivors contract to their own consensus; under an
     UNHEALED schedule the frozen rows act as disagreeing anchors that
     hold the live ranks apart — the stalled floor this function makes
-    measurable (benchmarks/chaos_resilience.py)."""
+    measurable (benchmarks/chaos_resilience.py).
+
+    ``weights`` overrides the specs' own tables with re-planned
+    per-round ``(class_weights, self_weights)`` pairs (one per spec,
+    cycled) — the same data a compiled step would be fed, so healed,
+    grown, and bootstrap-annealed schedules simulate through the one
+    code path (:func:`mixing_matrix_from_weights`)."""
     n = specs[0].size
     dead = (np.zeros(n, bool) if dead_mask is None
             else np.asarray(dead_mask, bool).reshape(-1))
@@ -182,7 +213,14 @@ def consensus_simulation(specs: Sequence[CommSpec], rounds: int,
         raise ValueError("no live ranks to simulate")
     rng = np.random.default_rng(seed)
     x = rng.standard_normal((n, dim))
-    mats = [mixing_matrix(s) for s in specs]
+    if weights is None:
+        mats = [mixing_matrix(s) for s in specs]
+    else:
+        if len(weights) != len(specs):
+            raise ValueError(
+                f"{len(weights)} weight pairs against {len(specs)} specs")
+        mats = [mixing_matrix_from_weights(s, cw, sw)
+                for s, (cw, sw) in zip(specs, weights)]
     trace = np.zeros(rounds)
     for t in range(rounds):
         new = mats[t % len(mats)] @ x

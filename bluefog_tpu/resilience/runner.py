@@ -47,10 +47,12 @@ __all__ = ["ResilienceEvent", "ResilientResult", "run_resilient"]
 class ResilienceEvent:
     """One entry of the run's event log: ``kind`` in {"checkpoint",
     "skip", "rank_dead", "rollback", "straggler",
-    "bad_window_unattributed"}; ``step`` is the step index the event
-    fired at; ``detail`` carries kind-specific fields (rollback:
+    "bad_window_unattributed", "rank_joining", "rank_promoted",
+    "rank_join_failed"}; ``step`` is the step index the event fired at;
+    ``detail`` carries kind-specific fields (rollback:
     ``restored_step``, ``backoff``, ``dead``; straggler: ``ranks``,
-    ``z``)."""
+    ``z``; the elastic kinds: ``rank``, plus ``disagreement``/``rounds``
+    on promotion and ``reason`` on a failed join)."""
 
     kind: str
     step: int
@@ -67,6 +69,9 @@ class ResilientResult:
     n_rollbacks: int
     dead_mask: np.ndarray         # [n] bool
     events: List[ResilienceEvent]
+    # final per-rank membership states ("live"/"dead"/"joining") when
+    # the run was elastic; None otherwise
+    membership: Optional[List[str]] = None
 
 
 def run_resilient(
@@ -89,6 +94,7 @@ def run_resilient(
     on_event: Optional[Callable[[ResilienceEvent], None]] = None,
     straggler=None,
     step_times_fn: Optional[Callable[[int, float], Any]] = None,
+    elastic=None,
 ) -> ResilientResult:
     """Train ``steps`` steps under faults; see the module docstring for
     the recovery semantics.
@@ -118,6 +124,21 @@ def run_resilient(
     also lands in the ``bf_step_wall_seconds{loop="train"}`` histogram,
     the local metric ``observe.fleet.collect_local`` picks up for
     gossip.
+
+    ``elastic`` (a :class:`bluefog_tpu.elastic.ElasticConfig`) turns on
+    the full membership lifecycle: between steps the loop polls the
+    admission signal (``elastic.admit``, defaulting to the fault plan's
+    ``rejoinable_ranks``) and moves returning dead ranks to JOINING —
+    quarantined bootstrap by pulled neighbor averaging
+    (:mod:`bluefog_tpu.elastic.bootstrap`), all of it weight DATA
+    through the one compiled step.  A joiner whose params' disagreement
+    against the live mean drops under the quarantine threshold is
+    PROMOTED (``rank_promoted``; the detector readmits it), one still
+    over threshold after ``max_quarantine_steps`` is kicked back to
+    DEAD (``rank_join_failed``), and a rollback kicks every in-flight
+    joiner (the restored checkpoint predates its bootstrap).  Requires
+    ``schedule=``; while elastic is on, the controller owns
+    ``comm_weights``.
     """
     if not hasattr(train_step, "default_comm_weights"):
         raise ValueError(
@@ -141,6 +162,36 @@ def run_resilient(
     dead = detector.dead_mask()
     if dead.any() and schedule:
         comm_weights = healed_comm_weights(schedule, dead)
+
+    controller = None
+    admit_fn = None
+    _bootstrap = None
+    if elastic is not None:
+        if not schedule:
+            raise ValueError(
+                "run_resilient(elastic=...) needs schedule= — membership "
+                "is a weight re-plan over the topology specs")
+        # imported here, not at module top: bluefog_tpu.elastic imports
+        # resilience.healing, and this module loads as part of the
+        # resilience package __init__
+        from bluefog_tpu.elastic import (MembershipController,
+                                         bootstrap as _bootstrap)
+
+        controller = MembershipController(
+            schedule,
+            bootstrap_rounds=elastic.bootstrap_rounds,
+            quarantine_threshold=elastic.quarantine_threshold,
+            detector=detector)
+        controller.seed_dead(dead)
+        if elastic.max_quarantine_steps < controller.bootstrap_rounds:
+            raise ValueError(
+                f"max_quarantine_steps ({elastic.max_quarantine_steps}) "
+                "must cover the bootstrap anneal "
+                f"({controller.bootstrap_rounds} rounds)")
+        admit_fn = elastic.admit
+        if admit_fn is None and fault_plan is not None:
+            admit_fn = fault_plan.rejoinable_ranks
+        comm_weights = controller.comm_weights()
 
     events: List[ResilienceEvent] = []
 
@@ -173,7 +224,37 @@ def run_resilient(
     step = 0
     save(0)  # rollback anchor: the pristine initial state
 
+    def sanitized(tree, mask):
+        # admission hygiene: a rank that died OUTSIDE the guard's
+        # frozen-finite invariant may carry garbage; fixed rows go back
+        # to the device with their original sharding
+        import jax
+
+        fixed = _bootstrap.sanitize_rank_rows(tree, mask)
+        if fixed is tree:
+            return tree
+        return jax.tree.map(
+            lambda new, old: old if new is old else (
+                jax.device_put(new, old.sharding)
+                if hasattr(old, "sharding") else new),
+            fixed, tree)
+
     while step < steps:
+        if controller is not None and admit_fn is not None:
+            wanting = [int(r) for r in admit_fn(step)
+                       if controller.is_dead(int(r))]
+            if wanting:
+                controller.admit(wanting)
+                if elastic.sanitize:
+                    jm = controller.joining_mask()
+                    params = sanitized(params, jm)
+                    opt_state = sanitized(opt_state, jm)
+                for r in wanting:
+                    emit("rank_joining", step, rank=r)
+        if controller is not None and controller.joining_ranks():
+            # the anneal advances every quarantined round — fresh
+            # weight DATA for the same compiled program
+            comm_weights = controller.comm_weights()
         batch = batch_fn(step)
         if fault_plan is not None:
             stall = fault_plan.stall_seconds(step)
@@ -222,6 +303,35 @@ def run_resilient(
                 z = straggler.z_scores()
                 emit("straggler", step, ranks=[int(r) for r in newly],
                      z=[float(z[r]) for r in newly])
+        if controller is not None:
+            joiners = controller.joining_ranks()
+            if joiners:
+                controller.tick()
+                check_every = max(1, elastic.check_every)
+                for r in joiners:
+                    prog = controller.progress(r)
+                    if (prog >= controller.bootstrap_rounds
+                            and (prog - controller.bootstrap_rounds)
+                            % check_every == 0):
+                        d = _bootstrap.disagreement(
+                            params, r, controller.live_mask())
+                        if observe.enabled():
+                            observe.get_registry().gauge(
+                                "bf_elastic_disagreement",
+                                "joiner bootstrap disagreement vs the "
+                                "live mean", rank=r).set(float(d))
+                        if d <= controller.quarantine_threshold:
+                            controller.promote([r])
+                            emit("rank_promoted", step, rank=r,
+                                 disagreement=float(d), rounds=prog)
+                            continue
+                        if prog >= elastic.max_quarantine_steps:
+                            controller.kick([r])
+                            emit("rank_join_failed", step, rank=r,
+                                 disagreement=float(d),
+                                 reason="quarantine_expired")
+                if controller.joining_ranks() != joiners:
+                    comm_weights = controller.comm_weights()
         live_bad = detector.live_bad(sk)
         if live_bad:
             # only LIVE-rank skips are events: a declared-dead rank
@@ -267,7 +377,18 @@ def run_resilient(
                     "run_resilient: every rank has been declared "
                     "dead — there is no surviving state to heal "
                     "around; the job must be restarted")
-            if schedule:
+            if controller is not None:
+                controller.mark_dead(newly)
+                # in-flight joiners are invalidated too: the restored
+                # checkpoint predates their bootstrap
+                stranded = controller.joining_ranks()
+                if stranded:
+                    controller.kick(stranded)
+                    for r in stranded:
+                        emit("rank_join_failed", step, rank=r,
+                             reason="rollback")
+                comm_weights = controller.comm_weights()
+            elif schedule:
                 comm_weights = healed_comm_weights(schedule, dead)
             state = checkpointer.restore_latest(mesh, like=like)
             params, opt_state = state["params"], state["opt_state"]
@@ -292,4 +413,5 @@ def run_resilient(
     return ResilientResult(
         params=params, opt_state=opt_state, step=step, last_loss=last_loss,
         total_skips=total_skips, n_rollbacks=n_rollbacks,
-        dead_mask=detector.dead_mask(), events=events)
+        dead_mask=detector.dead_mask(), events=events,
+        membership=controller.states() if controller is not None else None)

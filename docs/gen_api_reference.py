@@ -53,6 +53,12 @@ MODULES = [
      "topology healing: dead-rank weight re-planning"),
     ("bluefog_tpu.resilience.runner",
      "run_resilient: the skip/heal/rollback control loop"),
+    ("bluefog_tpu.elastic",
+     "elastic membership: ranks that join, not just die"),
+    ("bluefog_tpu.elastic.membership",
+     "membership lifecycle + grow_weights (heal's exact inverse)"),
+    ("bluefog_tpu.elastic.bootstrap",
+     "joiner bootstrap: annealed pull weights + disagreement gate"),
     ("bluefog_tpu.models", "model zoo: Llama, ResNet, ViT, MNIST nets"),
     ("bluefog_tpu.models.llama", "Llama config/stack, TP/EP/vocab-parallel"),
     ("bluefog_tpu.models.generate", "K/V-cached autoregressive decode"),

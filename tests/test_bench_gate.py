@@ -152,3 +152,47 @@ def test_gate_catches_fleet_regression(capsys):
     bad = {r["name"] for r in rows if r["regressed"]}
     assert "fleet_two.fleet_speedup" in bad
     assert "prefix.hit_rate" in bad
+
+
+# --------------------------------------------------------------------- #
+# chaos-resilience baseline (ISSUE 10): the chaos bench joins the same
+# rolling-baseline gate flow, with the preempt->rejoin record included
+# --------------------------------------------------------------------- #
+def test_chaos_bench_defaults_and_baseline():
+    """chaos_resilience.py gates against the committed r13 artifact by
+    default; ``--compare ''`` opts out; the committed record passed
+    every machine-checked claim including the rejoin cycle."""
+    cr = _load_bench_module("chaos_resilience")
+    args = cr.parse_args([])
+    assert args.compare == cr.DEFAULT_BASELINE
+    assert os.path.exists(args.compare)
+    assert cr.parse_args(["--compare", ""]).compare is None
+    assert cr.parse_args(["--compare", "x.json"]).compare == "x.json"
+    base = _load(os.path.join("benchmarks", "chaos_resilience_r13.json"))
+    assert all(base["checks"].values())
+    rejoin = base["rejoin"]
+    assert rejoin["recompiles"] == 0
+    assert rejoin["final_membership_all_live"]
+    assert rejoin["post_rejoin_floor"] <= 1e-12
+    assert rejoin["sim"]["grow_byte_equal"]
+    from bluefog_tpu.benchutil import bench_headline
+
+    head = bench_headline(base)
+    assert "rejoin.throughput_recovery" in head
+    assert "rejoin.post_rejoin_floor" in head
+
+
+def test_gate_catches_rejoin_regression(capsys):
+    """A blown consensus floor / collapsed throughput recovery after
+    rejoin fails the gate."""
+    from bluefog_tpu.benchutil import bench_compare
+
+    base = _load(os.path.join("benchmarks", "chaos_resilience_r13.json"))
+    regressed = copy.deepcopy(base)
+    regressed["rejoin"]["post_rejoin_floor"] = 1e-3
+    regressed["rejoin"]["throughput_recovery"] = 0.1
+    ok, rows = bench_compare(regressed, base, tolerance=0.5)
+    assert ok is False
+    bad = {r["name"] for r in rows if r["regressed"]}
+    assert "rejoin.post_rejoin_floor" in bad
+    assert "rejoin.throughput_recovery" in bad

@@ -517,7 +517,7 @@ def test_chaos_benchmark_smoke(tmp_path):
         [sys.executable, os.path.join(repo, "benchmarks",
                                       "chaos_resilience.py"),
          "--steps", "24", "--dim", "6", "--sim-rounds", "80",
-         "--out", out],
+         "--out", out, "--compare", ""],
         capture_output=True, text=True, timeout=600, env=env, cwd=repo)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     rec = json.load(open(out))
