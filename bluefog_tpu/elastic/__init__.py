@@ -38,6 +38,7 @@ from bluefog_tpu.elastic.bootstrap import (  # noqa: F401
     bootstrap_weights,
     disagreement,
     sanitize_rank_rows,
+    zero_rank_rows,
 )
 
 __all__ = [
@@ -54,4 +55,5 @@ __all__ = [
     "bootstrap_weights",
     "disagreement",
     "sanitize_rank_rows",
+    "zero_rank_rows",
 ]

@@ -49,6 +49,7 @@ __all__ = [
     "bootstrap_comm_weights",
     "disagreement",
     "sanitize_rank_rows",
+    "zero_rank_rows",
 ]
 
 
@@ -220,6 +221,41 @@ def sanitize_rank_rows(tree, rank_mask):
             return leaf
         arr = arr.copy()
         arr[mask] = np.where(np.isfinite(rows), rows, 0.0)
+        return arr
+
+    return jax.tree.map(fix, tree)
+
+
+def zero_rank_rows(tree, rank_mask):
+    """Zero the masked ranks' rows of every inexact rank-major leaf —
+    admission hygiene for OPTIMIZER state.  A rejoining rank's moments
+    are finite (the guard froze them) but STALE: they describe the
+    gradient field as of the preemption, and the promotion gate
+    measures params only, so :func:`sanitize_rank_rows` would wave them
+    through untouched.  Zeroing the rows at admission makes quarantine
+    rebuild the moments from fresh gradients, so a promoted rank's
+    first live updates are steered by current curvature, not
+    pre-preemption history.  Already-zero rows pass through as
+    identity (no copy); non-row leaves (int counters etc.) are left
+    alone."""
+    import jax
+
+    mask = np.asarray(rank_mask, bool).reshape(-1)
+    if not mask.any():
+        return tree
+
+    def fix(leaf):
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.inexact):
+            return leaf
+        if arr.ndim < 1 or arr.shape[0] != mask.shape[0]:
+            raise ValueError(
+                "zero_rank_rows needs rank-major leaves with leading "
+                f"dim {mask.shape[0]}, got shape {arr.shape}")
+        if not arr[mask].any():
+            return leaf
+        arr = arr.copy()
+        arr[mask] = 0.0
         return arr
 
     return jax.tree.map(fix, tree)

@@ -164,10 +164,16 @@ class ElasticConfig:
     a step; ``None`` derives it from the run's
     ``FaultPlan.rejoinable_ranks`` (deterministic replay).
     ``check_every``: disagreement-check cadence (steps) once the anneal
-    has finished.  ``sanitize``: zero non-finite entries on a joiner's
-    state rows at admission (a real re-attached host arrives with
-    garbage memory; the guard's frozen-finite invariant only covers
-    ranks that died in-graph)."""
+    has finished (the ``max_quarantine_steps`` deadline is enforced
+    every step regardless).  ``sanitize``: zero non-finite entries on a
+    joiner's state rows at admission (a real re-attached host arrives
+    with garbage memory; the guard's frozen-finite invariant only
+    covers ranks that died in-graph).  ``reset_opt_state``: zero the
+    joiner's OPTIMIZER state rows at admission
+    (:func:`bluefog_tpu.elastic.bootstrap.zero_rank_rows`) — the
+    promotion gate measures params only, so stale-but-finite
+    pre-preemption moments would otherwise rejoin silently; zeroed
+    moments rebuild from fresh gradients during quarantine."""
 
     bootstrap_rounds: Optional[int] = None
     quarantine_threshold: Optional[float] = None
@@ -175,6 +181,7 @@ class ElasticConfig:
     admit: Optional[Callable[[int], Sequence[int]]] = None
     check_every: int = 1
     sanitize: bool = True
+    reset_opt_state: bool = True
 
 
 class MembershipController:
@@ -377,7 +384,8 @@ class MembershipController:
         rank, with JOINING rows replaced by the annealed bootstrap
         pull.  Steady states (no joiner) are cached per membership
         pattern (bounded LRU — churn in both directions must not grow
-        host memory)."""
+        host memory); cached tables come back READ-ONLY, so treat them
+        as immutable and copy before editing."""
         from bluefog_tpu.elastic.bootstrap import bootstrap_weights
 
         anneal = self.anneal()
@@ -389,7 +397,14 @@ class MembershipController:
                 self._steady.move_to_end(key)
                 return [tuple(p) for p in hit]
             out = [bootstrap_weights(s, live, {}) for s in self.schedule]
-            self._steady[key] = tuple(tuple(p) for p in out)
+            # cached arrays are handed out on every later hit, so they
+            # are frozen: a caller mutating a returned table gets a
+            # loud ValueError instead of silently corrupting every
+            # subsequent render of this membership pattern
+            for cw, sw in out:
+                cw.flags.writeable = False
+                sw.flags.writeable = False
+            self._steady[key] = tuple(out)
             while len(self._steady) > _STEADY_CACHE_MAX:
                 self._steady.popitem(last=False)
             return out

@@ -52,7 +52,10 @@ class ResilienceEvent:
     ``detail`` carries kind-specific fields (rollback:
     ``restored_step``, ``backoff``, ``dead``; straggler: ``ranks``,
     ``z``; the elastic kinds: ``rank``, plus ``disagreement``/``rounds``
-    on promotion and ``reason`` on a failed join)."""
+    on promotion and ``reason`` on a failed join —
+    ``"quarantine_expired"``, ``"rollback"`` for an in-flight joiner a
+    rollback stranded, or ``"promotion_rolled_back"`` for a rank whose
+    promotion postdates the restored checkpoint)."""
 
     kind: str
     step: int
@@ -136,7 +139,15 @@ def run_resilient(
     PROMOTED (``rank_promoted``; the detector readmits it), one still
     over threshold after ``max_quarantine_steps`` is kicked back to
     DEAD (``rank_join_failed``), and a rollback kicks every in-flight
-    joiner (the restored checkpoint predates its bootstrap).  Requires
+    joiner (the restored checkpoint predates its bootstrap).  Promotion
+    forces a checkpoint on the next clean step so a promoted rank's
+    certified state is normally durable; if a rollback nevertheless
+    restores a step that predates a promotion (the promotion happened
+    inside the bad window, where checkpoints are refused), the promoted
+    rank is demoted back to DEAD (``rank_join_failed`` with
+    ``reason="promotion_rolled_back"``) so its rewound, uncertified
+    rows never mix into the fleet as live weight — the admission poll
+    re-offers it for a fresh quarantined bootstrap.  Requires
     ``schedule=``; while elastic is on, the controller owns
     ``comm_weights``.
     """
@@ -221,16 +232,16 @@ def run_resilient(
     last_loss: Optional[np.ndarray] = None
     consecutive_bad = 0
     n_rollbacks = 0
+    # a pending promotion forces a checkpoint on the next clean step,
+    # so restore_latest can normally never predate a promotion
+    force_ckpt = False
     step = 0
     save(0)  # rollback anchor: the pristine initial state
 
-    def sanitized(tree, mask):
-        # admission hygiene: a rank that died OUTSIDE the guard's
-        # frozen-finite invariant may carry garbage; fixed rows go back
-        # to the device with their original sharding
+    def _repack(fixed, tree):
+        # fixed rows go back to the device with their original sharding
         import jax
 
-        fixed = _bootstrap.sanitize_rank_rows(tree, mask)
         if fixed is tree:
             return tree
         return jax.tree.map(
@@ -239,16 +250,38 @@ def run_resilient(
                 if hasattr(old, "sharding") else new),
             fixed, tree)
 
+    def sanitized(tree, mask):
+        # admission hygiene: a rank that died OUTSIDE the guard's
+        # frozen-finite invariant may carry garbage
+        return _repack(_bootstrap.sanitize_rank_rows(tree, mask), tree)
+
+    def zeroed(tree, mask):
+        return _repack(_bootstrap.zero_rank_rows(tree, mask), tree)
+
+    # rank -> step it was promoted at: a rollback demotes any rank
+    # whose promotion the restored checkpoint does not contain
+    promoted_at: dict = {}
+
     while step < steps:
         if controller is not None and admit_fn is not None:
             wanting = [int(r) for r in admit_fn(step)
                        if controller.is_dead(int(r))]
             if wanting:
                 controller.admit(wanting)
+                # mask only the NEWLY admitted ranks: an in-flight
+                # joiner's rows are already mid-rebuild and must not be
+                # touched again
+                wm = np.zeros(n, bool)
+                wm[wanting] = True
                 if elastic.sanitize:
-                    jm = controller.joining_mask()
-                    params = sanitized(params, jm)
-                    opt_state = sanitized(opt_state, jm)
+                    params = sanitized(params, wm)
+                    opt_state = sanitized(opt_state, wm)
+                if elastic.reset_opt_state:
+                    # stale-but-finite optimizer moments pass the
+                    # params-only promotion gate untouched; zeroing
+                    # them makes quarantine rebuild the moments from
+                    # fresh gradients instead
+                    opt_state = zeroed(opt_state, wm)
                 for r in wanting:
                     emit("rank_joining", step, rank=r)
         if controller is not None and controller.joining_ranks():
@@ -310,9 +343,11 @@ def run_resilient(
                 check_every = max(1, elastic.check_every)
                 for r in joiners:
                     prog = controller.progress(r)
-                    if (prog >= controller.bootstrap_rounds
-                            and (prog - controller.bootstrap_rounds)
-                            % check_every == 0):
+                    at_check = (prog >= controller.bootstrap_rounds
+                                and (prog - controller.bootstrap_rounds)
+                                % check_every == 0)
+                    d = None
+                    if at_check:
                         d = _bootstrap.disagreement(
                             params, r, controller.live_mask())
                         if observe.enabled():
@@ -322,14 +357,22 @@ def run_resilient(
                                 "live mean", rank=r).set(float(d))
                         if d <= controller.quarantine_threshold:
                             controller.promote([r])
+                            promoted_at[r] = step
+                            force_ckpt = True
                             emit("rank_promoted", step, rank=r,
                                  disagreement=float(d), rounds=prog)
                             continue
-                        if prog >= elastic.max_quarantine_steps:
-                            controller.kick([r])
-                            emit("rank_join_failed", step, rank=r,
-                                 disagreement=float(d),
-                                 reason="quarantine_expired")
+                    # the deadline is enforced every tick, not only on
+                    # check-cadence steps — with check_every > 1 a
+                    # failed joiner must not linger past its quarantine
+                    # budget waiting for the next measurement
+                    if prog >= elastic.max_quarantine_steps:
+                        detail = {"rank": r,
+                                  "reason": "quarantine_expired"}
+                        if d is not None:
+                            detail["disagreement"] = float(d)
+                        controller.kick([r])
+                        emit("rank_join_failed", step, **detail)
                 if controller.joining_ranks() != joiners:
                     comm_weights = controller.comm_weights()
         live_bad = detector.live_bad(sk)
@@ -377,8 +420,13 @@ def run_resilient(
                     "run_resilient: every rank has been declared "
                     "dead — there is no surviving state to heal "
                     "around; the job must be restarted")
+            state = checkpointer.restore_latest(mesh, like=like)
+            params, opt_state = state["params"], state["opt_state"]
+            restored_step = int(state["step"])
             if controller is not None:
                 controller.mark_dead(newly)
+                for r in newly:
+                    promoted_at.pop(r, None)
                 # in-flight joiners are invalidated too: the restored
                 # checkpoint predates their bootstrap
                 stranded = controller.joining_ranks()
@@ -387,12 +435,36 @@ def run_resilient(
                     for r in stranded:
                         emit("rank_join_failed", step, rank=r,
                              reason="rollback")
+                # so is a rank PROMOTED after the restored checkpoint
+                # (its promotion happened inside the bad window, where
+                # checkpoints are refused): the restore rewinds its
+                # rows to mid-bootstrap state the disagreement gate
+                # never certified, so leaving it LIVE would mix
+                # uncertified weight into the fleet.  Demote to DEAD —
+                # the admission poll re-offers it for a fresh
+                # quarantined bootstrap.  A checkpoint at step T holds
+                # params after steps < T, so a promotion at step s is
+                # contained only when s < restored_step.
+                rewound = sorted(
+                    r for r, s in promoted_at.items()
+                    if s >= restored_step and controller.is_live(r))
+                if rewound:
+                    controller.mark_dead(rewound)
+                    for r in rewound:
+                        promoted_at.pop(r, None)
+                        emit("rank_join_failed", step, rank=r,
+                             reason="promotion_rolled_back")
+                    dead = detector.dead_mask()
+                    if dead.all():
+                        raise BluefogError(
+                            "run_resilient: every rank has been "
+                            "declared dead — there is no surviving "
+                            "state to heal around; the job must be "
+                            "restarted")
+                force_ckpt = False
                 comm_weights = controller.comm_weights()
             elif schedule:
                 comm_weights = healed_comm_weights(schedule, dead)
-            state = checkpointer.restore_latest(mesh, like=like)
-            params, opt_state = state["params"], state["opt_state"]
-            restored_step = int(state["step"])
             backoff = min(
                 guard.backoff_base * guard.backoff_factor ** n_rollbacks,
                 guard.max_backoff)
@@ -406,9 +478,11 @@ def run_resilient(
                 sleep(backoff)
             continue
 
-        if (checkpoint_every > 0 and step % checkpoint_every == 0
-                and not live_bad):
+        if (force_ckpt or (checkpoint_every > 0
+                           and step % checkpoint_every == 0)) \
+                and not live_bad:
             save(step)
+            force_ckpt = False
 
     return ResilientResult(
         params=params, opt_state=opt_state, step=step, last_loss=last_loss,

@@ -47,6 +47,7 @@ from bluefog_tpu.elastic import (
     grow_weights,
     grown_comm_weights,
     sanitize_rank_rows,
+    zero_rank_rows,
 )
 from bluefog_tpu.observe.fleet import FleetAggregator
 from bluefog_tpu.optim import functional as F
@@ -279,6 +280,25 @@ def test_sanitize_rank_rows():
         sanitize_rank_rows({"a": np.full((3, 2), np.nan)}, mask)
 
 
+def test_zero_rank_rows():
+    """Admission hygiene for optimizer state: the masked ranks' rows
+    are zeroed (stale-but-finite moments must not ride through the
+    params-only promotion gate), everything else is untouched, and
+    already-zero rows / empty masks are identity."""
+    tree = {"m": np.arange(1.0, 9.0).reshape(4, 2), "c": np.arange(4)}
+    mask = np.array([False, True, False, False])
+    out = zero_rank_rows(tree, mask)
+    assert (out["m"][1] == 0.0).all()
+    np.testing.assert_array_equal(out["m"][[0, 2, 3]],
+                                  tree["m"][[0, 2, 3]])
+    assert out["c"] is tree["c"]  # int passthrough
+    assert zero_rank_rows(tree, np.zeros(4, bool)) is tree
+    zeroed = {"m": np.zeros((4, 2))}
+    assert zero_rank_rows(zeroed, mask)["m"] is zeroed["m"]
+    with pytest.raises(ValueError, match="rank-major"):
+        zero_rank_rows({"m": np.ones((3, 2))}, mask)
+
+
 # ------------------------------------------------------------------ #
 # acceptance (c): controller lifecycle + detector readmission
 # ------------------------------------------------------------------ #
@@ -365,6 +385,12 @@ def test_controller_weights_cache_and_matrices():
     out1 = mc.comm_weight_arrays()
     out2 = mc.comm_weight_arrays()
     assert out1[0][0] is out2[0][0]  # cache hit: same arrays
+    # cached tables are frozen: a caller mutating a returned array
+    # must get a loud error, not silently corrupt later renders
+    assert not out1[0][0].flags.writeable
+    assert not out1[0][1].flags.writeable
+    with pytest.raises(ValueError, match="read-only"):
+        out1[0][0][0, 0] = 7.0
     mc.mark_dead(5)
     out3 = mc.comm_weight_arrays()
     assert out3[0][0] is not out1[0][0]
@@ -673,6 +699,80 @@ def test_quarantine_expiry_kicks(tmp_path):
     assert not any(e.kind == "rank_promoted" for e in res.events)
     assert res.dead_mask[2]  # the detector verdict was never reversed
     assert res.membership[2] in (DEAD, JOINING)
+
+
+def test_quarantine_expiry_enforced_between_checks(tmp_path):
+    """With ``check_every > 1`` the expiry deadline must not wait for
+    the next scheduled measurement: the joiner is kicked the tick its
+    quarantine budget runs out, without a disagreement reading."""
+    step_g, sched, mesh = _guarded_step()
+    params, opt_state = _state(mesh)
+    plan = R.FaultPlan.preempt(N, rank=2, step=4, duration=4)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    res = R.run_resilient(
+        step_g, params, opt_state, _batch_fn, steps=20,
+        checkpointer=ck, mesh=mesh, schedule=sched,
+        guard=F.GuardConfig(max_consecutive_bad=3, backoff_base=0.0),
+        fault_plan=plan, checkpoint_every=4, sleep=lambda s: None,
+        elastic=ElasticConfig(bootstrap_rounds=4,
+                              max_quarantine_steps=6,
+                              check_every=4,
+                              quarantine_threshold=-1.0))
+    ck.close()
+    joins = [e for e in res.events if e.kind == "rank_joining"]
+    fails = [e for e in res.events if e.kind == "rank_join_failed"]
+    assert joins and fails
+    # measurements land at progress 4, 8, ...; the deadline (6) falls
+    # between them — the kick fires there anyway, measurement-free
+    # (progress p is reached at the joining step + p - 1)
+    assert fails[0].step - joins[0].step == 5
+    assert "disagreement" not in fails[0].detail
+    assert all(e.detail["reason"] == "quarantine_expired" for e in fails)
+
+
+def test_rollback_demotes_promotion_past_restored_checkpoint(tmp_path):
+    """A rank PROMOTED inside a bad window (where checkpoints are
+    refused) must not stay LIVE through the rollback: the restore
+    rewinds its rows to mid-bootstrap state the disagreement gate never
+    certified, so the runner demotes it back to DEAD
+    (``reason="promotion_rolled_back"``), the admission poll re-offers
+    it, and it re-bootstraps cleanly.  On a clean step, promotion
+    instead FORCES a checkpoint so the certified state is durable."""
+    step_g, sched, mesh = _guarded_step()
+    params, opt_state = _state(mesh)
+    # rank 2: preempt -> rejoin; rank 5 dies RIGHT as rank 2 rejoins,
+    # so rank 2's promotion lands inside rank 5's bad window
+    plan = R.FaultPlan.preempt(N, rank=2, step=4, duration=4).merged(
+        R.FaultPlan(N, [R.Fault(8, 5, "dead")]))
+    ck = Checkpointer(str(tmp_path / "ck"))
+    res = R.run_resilient(
+        step_g, params, opt_state, _batch_fn, steps=24,
+        checkpointer=ck, mesh=mesh, schedule=sched,
+        guard=F.GuardConfig(max_consecutive_bad=3, backoff_base=0.0),
+        fault_plan=plan, checkpoint_every=4, sleep=lambda s: None,
+        elastic=ElasticConfig(bootstrap_rounds=2,
+                              max_quarantine_steps=16,
+                              quarantine_threshold=1e9))
+    ck.close()
+    joins = [e for e in res.events if e.kind == "rank_joining"]
+    promos = [e for e in res.events if e.kind == "rank_promoted"]
+    fails = [e for e in res.events if e.kind == "rank_join_failed"]
+    rollbacks = [e for e in res.events if e.kind == "rollback"]
+    assert [e.detail["rank"] for e in joins] == [2, 2]
+    assert [e.detail["rank"] for e in promos] == [2, 2]
+    assert len(fails) == 1 and fails[0].detail["rank"] == 2
+    assert fails[0].detail["reason"] == "promotion_rolled_back"
+    # the demotion was justified: the restore predates the promotion
+    assert rollbacks[1].detail["restored_step"] <= promos[0].step
+    # the re-promotion happened on a clean step and was made durable
+    # by a forced checkpoint right after it (step not on the cadence)
+    ckpt_steps = [e.step for e in res.events if e.kind == "checkpoint"]
+    assert promos[1].step + 1 in ckpt_steps
+    assert (promos[1].step + 1) % 4 != 0
+    assert res.n_rollbacks == 2
+    assert res.membership[5] == DEAD
+    assert [res.membership[r] for r in range(N) if r != 5] == [LIVE] * 7
+    assert not res.dead_mask[2] and res.dead_mask[5]
 
 
 @pytest.mark.slow
