@@ -682,10 +682,10 @@ _HEADLINE_HIGHER = ("value", "mfu", "tokens_per_sec", "useful_tokens",
                     "speedup_tokens_per_sec", "vs_baseline",
                     "compiled_advantage", "hit_rate",
                     "accepted_per_step", "fleet_speedup",
-                    "throughput_recovery")
+                    "throughput_recovery", "tp_overlap_fraction")
 _HEADLINE_LOWER = ("ttft_p50", "ttft_p99", "latency_p50", "latency_p99",
                    "makespan_s", "p99", "p50", "cost_to_consensus",
-                   "post_rejoin_floor")
+                   "post_rejoin_floor", "dcn_bytes_per_step")
 
 
 def bench_headline(record: dict) -> dict:
@@ -709,7 +709,8 @@ def bench_headline(record: dict) -> dict:
     grab(record, "")
     for section in ("continuous", "static", "chaos", "straggler",
                     "rejoin", "pod_4x8", "pod_8x16", "fleet_one",
-                    "fleet_two", "prefix", "speculative"):
+                    "fleet_two", "prefix", "speculative",
+                    "hierarchical"):
         if isinstance(record.get(section), dict):
             grab(record[section], section + ".")
     return out

@@ -24,6 +24,7 @@ __all__ = [
     "op_timeout",
     "fuse_epilogues",
     "fusion_threshold",
+    "hier_local_size",
     "kv_zero_on_free",
     "prefix_cache_mb",
     "elastic_bootstrap_rounds",
@@ -104,6 +105,25 @@ def fuse_epilogues() -> bool:
     (tests/test_epilogue.py)."""
     return _env("BLUEFOG_FUSE_EPILOGUES", "1") not in ("0", "false",
                                                        "False")
+
+
+def hier_local_size():
+    """BLUEFOG_HIER_LOCAL_SIZE (default unset): default intra-machine
+    group width of the HIERARCHICAL neighbor exchange — when set (>= 1),
+    :func:`bluefog_tpu.optim.functional.build_train_step` builds the
+    two-level combine (exact ICI allreduce inside each machine of this
+    many ranks, decentralized mixing of machine means across DCN) for
+    cta/atc steps that did not pass ``hierarchical=`` /
+    ``hierarchical_local_size=`` explicitly; the ``topology=`` /
+    ``schedule=`` specs must then be MACHINE-level.  Unset/0 keeps the
+    flat rank-level exchange.  Explicit builder arguments always win
+    over this env default."""
+    raw = _env("BLUEFOG_HIER_LOCAL_SIZE", "")
+    try:
+        v = int(raw)
+    except ValueError:
+        return None
+    return v if v >= 1 else None
 
 
 def kv_zero_on_free() -> bool:

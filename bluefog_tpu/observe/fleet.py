@@ -48,8 +48,8 @@ from typing import Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from bluefog_tpu.observe import registry as _registry_mod
-from bluefog_tpu.parallel.collectives import (machine_groups,
-                                              push_sum_structure)
+from bluefog_tpu.parallel.collectives import (
+    push_sum_structure, validate_machine_decomposition)
 from bluefog_tpu.topology.spec import DynamicTopology, Topology
 
 CommSpec = Union[Topology, DynamicTopology]
@@ -110,22 +110,32 @@ def gossip_edge_list(spec: CommSpec) -> List[tuple]:
 
 
 def record_edge_traffic(spec: CommSpec, payload_bytes: float,
-                        registry=None, pairs=None) -> None:
+                        registry=None, pairs=None,
+                        link: Optional[str] = None) -> None:
     """Add ``payload_bytes`` to ``bf_edge_bytes_total{src,dst}`` for
     every declared edge of ``spec`` (one exchange round) — or for the
     explicit ``pairs`` (e.g. :func:`gossip_edge_list` for push-sum
     wires).  Logical payload bytes — wire compression is not folded
-    in."""
+    in.
+
+    ``link`` ("ici"/"dcn") tags the counters with the fabric LEG the
+    bytes crossed — the hierarchical exchange bills its two legs
+    separately so :func:`traffic_snapshot` can hand the compiler's
+    ``PodSpec.from_telemetry`` only the expensive inter-machine load.
+    Unlabeled counters (flat exchanges, old recorders) stay the
+    back-compat family."""
     reg = registry if registry is not None else (
         _registry_mod.get_registry() if _registry_mod.enabled() else None)
     if reg is None:
         return
+    extra = {} if link is None else {"link": link}
     for (src, dst) in (edge_list(spec) if pairs is None else pairs):
         reg.counter("bf_edge_bytes_total", _EDGE_BYTES_HELP,
-                    src=src, dst=dst).inc(payload_bytes)
+                    src=src, dst=dst, **extra).inc(payload_bytes)
 
 
-def traffic_snapshot(registry=None) -> Dict[tuple, float]:
+def traffic_snapshot(registry=None,
+                     link: Optional[str] = None) -> Dict[tuple, float]:
     """The accumulated per-edge exchange traffic, read back OUT of the
     registry: ``{(src, dst): bytes}`` from every
     ``bf_edge_bytes_total{src,dst}`` counter — the feed the topology
@@ -133,7 +143,13 @@ def traffic_snapshot(registry=None) -> Dict[tuple, float]:
     cost model consumes, so synthesized schedules adapt to the link
     traffic the fleet actually measured (train-step exchanges + gossip
     wire cost, everything :func:`record_edge_traffic` billed).  Empty
-    when observability is off or nothing was recorded."""
+    when observability is off or nothing was recorded.
+
+    ``link=None`` sums every family (labeled or not — the whole-fleet
+    view); ``link="dcn"``/``link="ici"`` selects ONLY the counters
+    tagged with that leg by a hierarchical recorder, which is what
+    hierarchical ``PodSpec.from_telemetry`` calibration reads so cheap
+    intra-machine traffic never masquerades as DCN load."""
     reg = registry if registry is not None else (
         _registry_mod.get_registry() if _registry_mod.enabled() else None)
     if reg is None:
@@ -141,6 +157,8 @@ def traffic_snapshot(registry=None) -> Dict[tuple, float]:
     out: Dict[tuple, float] = {}
     for name, kind, _help, labels, m in reg.collect():
         if name != "bf_edge_bytes_total" or kind != "counter":
+            continue
+        if link is not None and labels.get("link") != link:
             continue
         try:
             key = (int(labels["src"]), int(labels["dst"]))
@@ -380,13 +398,13 @@ class FleetAggregator:
             f"m{j}" for j in range(k))
         dead = _resolve_dead_mask(dead_mask, n)
         live = ~dead
-        groups = machine_groups(n, local_size)
         if isinstance(machine_schedule, (Topology, DynamicTopology)):
             machine_schedule = [machine_schedule]
-        m = machine_schedule[0].size
-        if m != len(groups):
-            raise ValueError(f"machine schedule of size {m} against "
-                             f"{len(groups)} machines")
+        # the one shared machine-decomposition validator (also the
+        # training exchange's — collectives.py is the source of truth)
+        groups = validate_machine_decomposition(n, local_size,
+                                                machine_schedule)
+        m = len(groups)
         sums = np.zeros((m, k))
         counts = np.zeros(m)
         for mi, g in enumerate(groups):
@@ -430,7 +448,8 @@ class FleetAggregator:
         # family covers flat and hierarchical gossip
         self._record_gossip_traffic(
             machine_schedule, rounds, k, mdead,
-            relabel=lambda s, d: (s * local_size, d * local_size))
+            relabel=lambda s, d: (s * local_size, d * local_size),
+            link="dcn")
         return FleetAggregate(names=names, per_rank=per_rank,
                               mean=per_rank[filled].mean(axis=0),
                               rounds=rounds, spread=spread)
@@ -445,13 +464,16 @@ class FleetAggregator:
                 if _registry_mod.enabled() else None)
 
     def _record_gossip_traffic(self, schedule, rounds: int, k: int,
-                               dead: np.ndarray, relabel=None) -> None:
+                               dead: np.ndarray, relabel=None,
+                               link: Optional[str] = None) -> None:
         """The gossip's OWN wire cost, per edge: each round pushes the
         ``k`` metric scalars + the push-sum weight as f64.  Only edges
         that actually push are billed (:func:`gossip_edge_list` —
         zero-weight declared edges carry nothing); ``relabel`` maps
         schedule-level edges to rank-level labels (the hierarchical
-        path's machine→leader-rank attribution)."""
+        path's machine→leader-rank attribution), and ``link`` tags the
+        leg like :func:`record_edge_traffic` (the hierarchical
+        inter-machine gossip is DCN traffic)."""
         reg = self._reg()
         if reg is None or not self.record_traffic or rounds == 0:
             return
@@ -468,9 +490,10 @@ class FleetAggregator:
                     continue
                 key = (s, d) if relabel is None else relabel(s, d)
                 totals[key] = totals.get(key, 0.0) + payload * uses
+        extra = {} if link is None else {"link": link}
         for (s, d), b in totals.items():
             reg.counter("bf_edge_bytes_total", _EDGE_BYTES_HELP,
-                        src=s, dst=d).inc(b)
+                        src=s, dst=d, **extra).inc(b)
 
     def publish(self, names: Sequence[str], values, dead_mask=None
                 ) -> FleetAggregate:

@@ -303,14 +303,28 @@ def _loss_and_grads(loss_fn, has_aux, sp_axis, pp_axis, param_specs,
 
 def _weighted_combine_fn(spec: CommSpec, axis_name: str,
                          compress: Optional[str],
-                         n_buckets: Optional[int]) -> Callable:
+                         n_buckets: Optional[int],
+                         hierarchical_local_size: Optional[int] = None,
+                         ) -> Callable:
     """Combine branch ``fn(tree, key, (class_w, self_w))`` with the
     weights as traced operands — ``spec`` contributes only the edge
     structure (same design as windows.py's put/update kernels).  With
     ``n_buckets`` the bucketed overlap packing is applied around the
-    weighted combine."""
+    weighted combine.  Under ``hierarchical_local_size`` the spec and
+    the weight tables are MACHINE-level and the exchange is the
+    two-level combine (compression on the DCN leg only)."""
     wire = compress == "int8_sr"
     wire_compress = "int8" if wire else compress
+    hls = hierarchical_local_size
+
+    def one(p, key, cw, sw):
+        if hls is not None:
+            return C.hierarchical_neighbor_allreduce(
+                p, spec, hls, axis_name, compress=wire_compress,
+                wire_key=key, class_weights=cw, self_weights=sw)
+        return C.neighbor_allreduce(
+            p, spec, axis_name, compress=wire_compress, wire_key=key,
+            class_weights=cw, self_weights=sw)
 
     def fn(tree, key, w):
         cw, sw = w
@@ -319,11 +333,8 @@ def _weighted_combine_fn(spec: CommSpec, axis_name: str,
             return tree
         if n_buckets is None:
             outs = [
-                C.neighbor_allreduce(
-                    p, spec, axis_name, compress=wire_compress,
-                    wire_key=(jax.random.fold_in(key, i) if wire
-                              else None),
-                    class_weights=cw, self_weights=sw)
+                one(p, (jax.random.fold_in(key, i) if wire else None),
+                    cw, sw)
                 for i, p in enumerate(leaves)
             ]
             return jax.tree_util.tree_unflatten(treedef, outs)
@@ -332,6 +343,7 @@ def _weighted_combine_fn(spec: CommSpec, axis_name: str,
         combined = C.neighbor_allreduce_buckets(
             buffers, spec, axis_name, compress=wire_compress,
             wire_key=key if wire else None,
+            hierarchical_local_size=hls,
             class_weights=cw, self_weights=sw)
         outs = [None] * len(leaves)
         for g, buf in zip(groups, combined):
@@ -575,7 +587,8 @@ def _bucketed_apply_combine_fn(spec: CommSpec, axis_name: str,
             wk = jax.random.fold_in(key, bi) if wire else None
             if hierarchical_local_size is not None:
                 out = C.hierarchical_neighbor_allreduce(
-                    buf, spec, hierarchical_local_size, axis_name)
+                    buf, spec, hierarchical_local_size, axis_name,
+                    compress=wire_compress, wire_key=wk)
             else:
                 out = C.neighbor_allreduce(
                     buf, spec, axis_name, compress=wire_compress,
@@ -593,9 +606,21 @@ def _combine_fn(spec: CommSpec, axis_name: str,
     wire rounder under ``compress='int8_sr'`` and is ignored (then DCE'd
     by XLA) everywhere else."""
     if hierarchical_local_size is not None:
-        return lambda tree, key: jax.tree.map(
-            lambda p: C.hierarchical_neighbor_allreduce(
-                p, spec, hierarchical_local_size, axis_name), tree)
+        wire = compress == "int8_sr"
+        wire_compress = "int8" if wire else compress
+
+        def hier_fn(tree, key):
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            outs = [
+                C.hierarchical_neighbor_allreduce(
+                    p, spec, hierarchical_local_size, axis_name,
+                    compress=wire_compress,
+                    wire_key=(jax.random.fold_in(key, i) if wire
+                              else None))
+                for i, p in enumerate(leaves)
+            ]
+            return jax.tree_util.tree_unflatten(treedef, outs)
+        return hier_fn
     if compress == "int8_sr":
         def fn(tree, key):
             leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -624,21 +649,28 @@ def _observed_step(step_fn: Callable, labels: dict,
     dispatch (jax is async); sync before reading it as a step time.
 
     ``edge_traffic`` — ``(specs, step_argpos, k_comm, n_ranks,
-    filtered)`` for the neighbor modes: per on-cycle dispatch, the
-    round's edges each get the per-rank parameter payload added to
-    ``bf_edge_bytes_total{src,dst}`` through
+    filtered, local_size)`` for the neighbor modes: per on-cycle
+    dispatch, the round's edges each get the per-rank parameter payload
+    added to ``bf_edge_bytes_total{src,dst}`` through
     ``observe.fleet.record_edge_traffic`` (logical bytes — wire
     compression is not folded in), the fleet-telemetry traffic account
     derived from the topology's shift classes.  ``filtered`` selects
     the weight-filtered push-sum edge set (``push_sum_mix`` only
     ppermutes nonzero-weight edges) instead of the declared one
     (``neighbor_allreduce`` moves bytes on every declared edge — its
-    weights are traced operands)."""
+    weights are traced operands).  Under a hierarchical exchange
+    (``local_size`` set, ``specs`` machine-level) the two legs are
+    billed SEPARATELY — the intra-machine ring edges as
+    ``link="ici"`` and the expanded counterpart machine edges as
+    ``link="dcn"`` — so ``PodSpec.from_telemetry`` can calibrate the
+    inter-machine links without mistaking cheap ICI traffic for DCN
+    load."""
     payload_cache: list = []
     pairs_cache: dict = {}
 
     def record_edges(args) -> None:
-        specs, step_argpos, k_comm, n_ranks, filtered = edge_traffic
+        specs, step_argpos, k_comm, n_ranks, filtered, local_size = \
+            edge_traffic
         try:
             step_i = int(args[step_argpos])
         except (TypeError, ValueError, IndexError):
@@ -652,6 +684,26 @@ def _observed_step(step_fn: Callable, labels: dict,
         from bluefog_tpu.observe import fleet as _fleet
 
         si = step_i % len(specs)
+        if local_size:
+            pairs = pairs_cache.get(si)
+            if pairs is None:
+                L = int(local_size)
+                dcn = [(ms * L + j, md * L + j)
+                       for (ms, md) in _fleet.edge_list(specs[si])
+                       for j in range(L)]
+                ici = []
+                for g in C.machine_groups(n_ranks, L):
+                    if len(g) > 1:
+                        ici.extend((g[k], g[(k + 1) % len(g)])
+                                   for k in range(len(g)))
+                pairs = pairs_cache[si] = (ici, dcn)
+            ici, dcn = pairs
+            if ici:
+                _fleet.record_edge_traffic(specs[si], payload_cache[0],
+                                           pairs=ici, link="ici")
+            _fleet.record_edge_traffic(specs[si], payload_cache[0],
+                                       pairs=dcn, link="dcn")
+            return
         pairs = pairs_cache.get(si)
         if pairs is None:
             pairs = pairs_cache[si] = (
@@ -721,10 +773,12 @@ def _build_fused_train_step(
     want_health = health is not None
     want_cons = want_health and health.consensus
     neighbor = comm_mode in ("cta", "atc") and bool(specs)
-    # traced combine-weight operands: the flat neighbor exchange only —
-    # hierarchical weights are machine-level constants, push_sum derives
-    # its column-stochastic scales from the edge structure
-    use_traced_w = neighbor and hierarchical_local_size is None
+    # traced combine-weight operands for every neighbor exchange: flat
+    # tables are rank-level, hierarchical tables are MACHINE-level (the
+    # machine is the failure domain — healing/elastic swap the
+    # inter-machine matrix as data); push_sum derives its
+    # column-stochastic scales from the edge structure
+    use_traced_w = neighbor
     wire = compress == "int8_sr"
     wire_compress = "int8" if wire else compress
     zero = lambda: jnp.zeros((), jnp.float32)
@@ -748,11 +802,15 @@ def _build_fused_train_step(
             cons = zero()
             for b in plan.buckets:
                 pre = _pack_bucket(leaves, list(b.leaves))
+                cw, sw = w
                 if hierarchical_local_size is not None:
                     out = C.hierarchical_neighbor_allreduce(
-                        pre, spec, hierarchical_local_size, axis_name)
+                        pre, spec, hierarchical_local_size, axis_name,
+                        compress=wire_compress,
+                        wire_key=(jax.random.fold_in(key, b.index)
+                                  if wire else None),
+                        class_weights=cw, self_weights=sw)
                 else:
-                    cw, sw = w
                     out = C.neighbor_allreduce(
                         pre, spec, axis_name, compress=wire_compress,
                         wire_key=(jax.random.fold_in(key, b.index)
@@ -789,11 +847,15 @@ def _build_fused_train_step(
                     fresh[i] = optax.apply_updates(leaves[i],
                                                    upd_leaves[i])
                 pre = _pack_bucket(fresh, g)
+                cw, sw = w
                 if hierarchical_local_size is not None:
                     out = C.hierarchical_neighbor_allreduce(
-                        pre, spec, hierarchical_local_size, axis_name)
+                        pre, spec, hierarchical_local_size, axis_name,
+                        compress=wire_compress,
+                        wire_key=(jax.random.fold_in(key, b.index)
+                                  if wire else None),
+                        class_weights=cw, self_weights=sw)
                 else:
-                    cw, sw = w
                     out = C.neighbor_allreduce(
                         pre, spec, axis_name, compress=wire_compress,
                         wire_key=(jax.random.fold_in(key, b.index)
@@ -1040,7 +1102,8 @@ def _build_fused_train_step(
     needs_topo = comm_mode in ("cta", "atc", "push_sum")
     edge_traffic = (list(specs), 4 if has_aux else 3, k_comm,
                     int(mesh.shape[axis_name]),
-                    comm_mode == "push_sum") \
+                    comm_mode == "push_sum",
+                    hierarchical_local_size if neighbor else None) \
         if (specs and needs_topo) else None
 
     stages = _fusion.epilogue_stages(
@@ -1053,6 +1116,8 @@ def _build_fused_train_step(
         step_fn.health_config = health
         step_fn.epilogue_stages = stages
         step_fn.has_aux = has_aux
+        step_fn.hierarchical_local_size = \
+            hierarchical_local_size if neighbor else None
         if guarded:
             step_fn.guard_config = guard
         if guarded or use_traced_w:
@@ -1130,6 +1195,7 @@ def build_train_step(
     schedule: Optional[Sequence[CommSpec]] = None,
     num_steps_per_communication: int = 1,
     hierarchical_local_size: Optional[int] = None,
+    hierarchical: Any = None,
     sp_axis: Optional[str] = None,
     pp_axis: Optional[str] = None,
     batch_specs: Any = None,
@@ -1221,8 +1287,27 @@ def build_train_step(
 
     With no faults present the guarded step's (params, opt_state,
     loss) are bit-identical to the unguarded step's.  Not supported
-    with ``comm_mode='push_sum'`` (the (x, w) pair must mix as a unit)
-    or ``hierarchical_local_size`` (weights there are machine-level).
+    with ``comm_mode='push_sum'`` (the (x, w) pair must mix as a unit).
+    Under a hierarchical exchange the guard composes at MACHINE
+    granularity: ``comm_weights`` are the machine-level tables and
+    ``resilience.healing.healed_hierarchical_comm_weights`` collapses a
+    rank-level dead mask to the machine failure domain.
+
+    **Hierarchical exchange** — ``hierarchical=PodSpec(...)`` (or a
+    plain int local size; equivalently ``hierarchical_local_size=``, or
+    the ``BLUEFOG_HIER_LOCAL_SIZE`` env default) decomposes the cta/atc
+    combine into ``W_dcn ⊗ exact-local-mean``: ONE exact intra-machine
+    allreduce over the ICI submesh (``collectives.machine_groups``),
+    then decentralized weighted mixing of the machine means over the
+    (smaller) inter-machine schedule — ``topology=``/``schedule=`` are
+    then MACHINE-level specs of size ``n_ranks / local_size`` (the
+    hierarchical compiler emits them: ``topology.compiler.
+    compile_topology(..., hierarchical=...)``).  ``compress=`` applies
+    to the DCN leg only (the ICI reduce stays full precision), and the
+    combine weights ride as traced MACHINE-level tables, so healing and
+    elastic membership swap the inter-machine matrix as pure data —
+    zero recompiles.  With ``local_size == 1`` the step is bitwise the
+    flat exchange.
 
     ``health=HealthConfig(...)`` additionally emits a rank-major
     :class:`HealthVector` as the step's LAST output — loss, local grad
@@ -1266,10 +1351,37 @@ def build_train_step(
     if needs_topo and (topology is None) == (schedule is None):
         raise ValueError(
             "neighbor modes need exactly one of topology= or schedule=")
+    if hierarchical is not None:
+        # a PodSpec (duck-typed: machines/chips_per_machine) or a plain
+        # int local size — either way it resolves to the ICI group width
+        hier_l = int(getattr(hierarchical, "chips_per_machine",
+                             hierarchical))
+        if (hierarchical_local_size is not None
+                and int(hierarchical_local_size) != hier_l):
+            raise ValueError(
+                f"hierarchical={hierarchical!r} (local size {hier_l}) "
+                f"conflicts with hierarchical_local_size="
+                f"{hierarchical_local_size!r}")
+        hierarchical_local_size = hier_l
+    if hierarchical_local_size is None and comm_mode in ("cta", "atc"):
+        hierarchical_local_size = _config.hier_local_size()
     if comm_mode == "push_sum" and hierarchical_local_size is not None:
         raise ValueError(
             "hierarchical_local_size is not supported with "
             "comm_mode='push_sum' (flat rank-level push-sum only)")
+    if hierarchical_local_size is not None and comm_mode in ("cta", "atc"):
+        n_ranks = int(mesh.shape[axis_name])
+        hier_specs = ([topology] if topology is not None
+                      else list(schedule or []))
+        C.validate_machine_decomposition(
+            n_ranks, hierarchical_local_size, hier_specs)
+        machines = getattr(hierarchical, "machines", None)
+        if machines is not None and \
+                int(machines) * int(hierarchical_local_size) != n_ranks:
+            raise ValueError(
+                f"hierarchical pod of {machines} machines x "
+                f"{hierarchical_local_size} chips does not cover the "
+                f"{n_ranks}-rank mesh axis {axis_name!r}")
     if pp_axis is not None and param_specs is None:
         raise ValueError(
             "pp_axis requires param_specs: the spec tree is what tells "
@@ -1278,11 +1390,10 @@ def build_train_step(
     if compress is not None:
         if compress not in ("int8", "int8_sr", "bf16"):
             raise ValueError(f"unknown compress mode {compress!r}")
-        if comm_mode not in ("cta", "atc") or hierarchical_local_size:
+        if comm_mode not in ("cta", "atc"):
             raise ValueError(
-                "compress= is only honored by the flat cta/atc combine "
-                f"(got comm_mode={comm_mode!r}, hierarchical_local_size="
-                f"{hierarchical_local_size!r})")
+                "compress= is only honored by the cta/atc combine "
+                f"(got comm_mode={comm_mode!r})")
     if overlap not in ("none", "bucketed"):
         raise ValueError(f"unknown overlap mode {overlap!r}")
     if guard is not None:
@@ -1292,11 +1403,6 @@ def build_train_step(
                 "(params, ps_weight) pair must mix as a unit, and a "
                 "per-rank skip would break the column-stochastic "
                 "sum(ps) == n invariant")
-        if hierarchical_local_size is not None:
-            raise ValueError(
-                "guard= requires hierarchical_local_size=None (healing "
-                "delivers rank-level weight data; the hierarchical "
-                "combine takes machine-level weights)")
     if overlap == "bucketed":
         if comm_mode not in ("cta", "atc", "push_sum"):
             raise ValueError(
@@ -1333,6 +1439,7 @@ def build_train_step(
             loss_fn, optimizer, mesh, guard=guard, axis_name=axis_name,
             comm_mode=comm_mode, specs=specs,
             num_steps_per_communication=num_steps_per_communication,
+            hierarchical_local_size=hierarchical_local_size,
             sp_axis=sp_axis, pp_axis=pp_axis, batch_specs=batch_specs,
             param_specs=param_specs, opt_state_specs=opt_state_specs,
             donate=donate, has_aux=has_aux, compress=compress,
@@ -1523,13 +1630,17 @@ def build_train_step(
     # 'gradient_allreduce' must not count phantom edge bytes
     edge_traffic = (list(specs), 4 if has_aux else 3, k_comm,
                     int(mesh.shape[axis_name]),
-                    comm_mode == "push_sum") \
+                    comm_mode == "push_sum",
+                    hierarchical_local_size
+                    if comm_mode in ("cta", "atc") else None) \
         if (specs and needs_topo) else None
     if has_aux:
         aux_step = _observed_step(jitted, obs_labels, edge_traffic)
         aux_step.jitted = jitted
         aux_step.lower = jitted.lower
         aux_step.health_config = health
+        aux_step.hierarchical_local_size = \
+            hierarchical_local_size if comm_mode in ("cta", "atc") else None
         return aux_step
 
     if health is None:
@@ -1550,6 +1661,8 @@ def build_train_step(
     step_fn.lower = lambda params, opt_state, batch, step: jitted.lower(
         params, (), opt_state, batch, step)
     step_fn.health_config = health
+    step_fn.hierarchical_local_size = \
+        hierarchical_local_size if comm_mode in ("cta", "atc") else None
     return step_fn
 
 
@@ -1563,6 +1676,7 @@ def _build_guarded_train_step(
     comm_mode: str,
     specs: Sequence[CommSpec],
     num_steps_per_communication: int,
+    hierarchical_local_size: Optional[int],
     sp_axis: Optional[str],
     pp_axis: Optional[str],
     batch_specs: Any,
@@ -1583,7 +1697,8 @@ def _build_guarded_train_step(
     k_comm = int(num_steps_per_communication)
     neighbor = comm_mode in ("cta", "atc")
     wbranches = [
-        _weighted_combine_fn(s, axis_name, compress, n_buckets)
+        _weighted_combine_fn(s, axis_name, compress, n_buckets,
+                             hierarchical_local_size)
         for s in specs
     ] if neighbor else []
 
@@ -1700,7 +1815,8 @@ def _build_guarded_train_step(
     # guarded steps are cta/atc only — neighbor_allreduce moves bytes
     # on every declared edge, so the unfiltered edge set is correct
     edge_traffic = (list(specs), 4 if has_aux else 3, k_comm,
-                    int(mesh.shape[axis_name]), False) \
+                    int(mesh.shape[axis_name]), False,
+                    hierarchical_local_size) \
         if wbranches else None
     if has_aux:
         def aux_step(params, aux, opt_state, batch, step, comm_weights):
@@ -1713,6 +1829,8 @@ def _build_guarded_train_step(
         step_fn.has_aux = True  # run_resilient rejects aux signatures
         step_fn.guard_config = guard
         step_fn.health_config = health
+        step_fn.hierarchical_local_size = \
+            hierarchical_local_size if neighbor else None
         return step_fn
 
     if health is None:
@@ -1735,4 +1853,6 @@ def _build_guarded_train_step(
     step_fn.has_aux = False
     step_fn.guard_config = guard
     step_fn.health_config = health
+    step_fn.hierarchical_local_size = \
+        hierarchical_local_size if neighbor else None
     return step_fn

@@ -58,6 +58,7 @@ __all__ = [
     "push_sum_mix",
     "hierarchical_neighbor_allreduce",
     "machine_groups",
+    "validate_machine_decomposition",
 ]
 
 
@@ -349,22 +350,26 @@ def neighbor_allreduce_buckets(
     ``wire_key`` (with ``compress="int8"``) is folded with the BUCKET
     index so every bucket draws independent stochastic-rounding noise;
     ``hierarchical_local_size`` routes buckets through the machine-level
-    combine instead.  ``class_weights``/``self_weights`` (flat path
-    only) supply the combine weights as TRACED OPERANDS shared by every
+    combine instead (``spec`` is then the MACHINE schedule, compression
+    applies to the DCN leg only).  ``class_weights``/``self_weights``
+    supply the combine weights as TRACED OPERANDS shared by every
     bucket — the resilience layer's topology-healing delivery, same
-    contract as ``neighbor_allreduce``.  Numerics per element are identical to the per-leaf
+    contract as ``neighbor_allreduce`` (machine-level tables under
+    ``hierarchical_local_size``).  Numerics per element are identical to the per-leaf
     ``neighbor_allreduce`` (the weighted combine distributes over
     concatenation) except for int8's per-TENSOR absmax scale, which under
     bucketing is per-BUCKET.
     """
     outs = []
     for i, buf in enumerate(buffers):
-        if hierarchical_local_size is not None:
-            outs.append(hierarchical_neighbor_allreduce(
-                buf, spec, hierarchical_local_size, axis_name))
-            continue
         key = (jax.random.fold_in(wire_key, i)
                if wire_key is not None else None)
+        if hierarchical_local_size is not None:
+            outs.append(hierarchical_neighbor_allreduce(
+                buf, spec, hierarchical_local_size, axis_name,
+                compress=compress, wire_key=key,
+                class_weights=class_weights, self_weights=self_weights))
+            continue
         outs.append(neighbor_allreduce(
             buf, spec, axis_name, compress=compress, wire_key=key,
             class_weights=class_weights, self_weights=self_weights))
@@ -580,11 +585,39 @@ def push_sum_mix(tree, ps_weight: jax.Array, spec: CommSpec,
 
 def machine_groups(size: int, local_size: int) -> list:
     """Partition ranks [0, size) into machines of ``local_size`` ranks."""
-    assert size % local_size == 0
+    local_size = int(local_size)
+    if local_size < 1:
+        raise ValueError(f"local_size must be >= 1, got {local_size}")
+    if size % local_size != 0:
+        raise ValueError(
+            f"rank count {size} is not divisible by local_size {local_size}")
     return [
         list(range(m * local_size, (m + 1) * local_size))
         for m in range(size // local_size)
     ]
+
+
+def validate_machine_decomposition(n_ranks: int, local_size: int,
+                                   machine_specs: Sequence[CommSpec] = ()
+                                   ) -> list:
+    """Shared validation for the two-level machine decomposition: the
+    rank count must tile into machines of ``local_size``, and every
+    machine-level schedule spec must be sized to the MACHINE count (not
+    the rank count).  Returns the intra-machine rank groups (the
+    ``axis_index_groups`` of the ICI reduce).
+
+    This is the single source of truth for both the training exchange
+    (:func:`hierarchical_neighbor_allreduce`, ``build_train_step``) and
+    the metrics plane (``observe.fleet.aggregate_hierarchical``)."""
+    groups = machine_groups(n_ranks, local_size)
+    m = len(groups)
+    for s in machine_specs:
+        if s.size != m:
+            raise ValueError(
+                f"machine schedule of size {s.size} does not match "
+                f"{m} machines ({n_ranks} ranks / local_size "
+                f"{int(local_size)})")
+    return groups
 
 
 def hierarchical_neighbor_allreduce(
@@ -592,45 +625,134 @@ def hierarchical_neighbor_allreduce(
     machine_spec: CommSpec,
     local_size: int,
     axis_name: str,
+    compress: Optional[str] = None,
     class_weights: Optional[jax.Array] = None,
     self_weights: Optional[jax.Array] = None,
+    wire_key: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Machine-level neighbor averaging.
+    """Machine-level neighbor averaging: ``W_dcn ⊗ exact-local-mean``.
 
     Reference semantics (mpi_controller.cc:656-725, nccl_controller.cc:800-
     860): (1) intra-machine allreduce-average forms a "super node", (2) the
     machine means are neighbor-averaged over the machine topology, (3) the
-    result is shared intra-machine.  On TPU step (1) is a grouped ``psum``
+    result is shared intra-machine.  On TPU step (1) is ONE grouped ``psum``
     (over the intra slice of the rank axis — ICI-local), step (2) is a
     ppermute where every local rank talks to its counterpart on the neighbor
     machine (so no separate broadcast step (3) is needed: all local ranks
-    already hold the machine mean).
+    already hold the machine mean).  Per-machine DCN cost per round drops
+    from ``deg(rank) * full-width`` sends to one machine-mean exchange.
+
+    ``compress`` ("int8"/"bf16") and ``wire_key`` (stochastic rounding, see
+    :func:`neighbor_allreduce`) apply to the DCN leg ONLY: the machine means
+    crossing machines are quantized; the intra-machine reduce always runs
+    full precision — ICI bandwidth is nearly free, and keeping the exact
+    local mean means quantization noise enters the mixing recursion once
+    per round, not twice.
+
+    ``class_weights`` ([n_machine_classes, n_machines]) and
+    ``self_weights`` ([n_machines]) supply the INTER-MACHINE combine
+    weights as traced operands — the healing/elastic delivery path, at
+    machine granularity (the machine is the failure domain).
+
+    With ``local_size == 1`` the decomposition is exact flat neighbor
+    averaging: the singleton-group psum is the identity, the counterpart
+    expansion reproduces the rank-level permutes, and the arithmetic
+    (including the int8 per-rank fold of ``wire_key``) mirrors
+    :func:`neighbor_allreduce` bitwise.
     """
+    if compress not in (None, "int8", "bf16"):
+        raise ValueError(f"unknown compress mode {compress!r}")
+    if wire_key is not None and compress != "int8":
+        raise ValueError("wire_key= requires compress='int8'")
     n_total = machine_spec.size * local_size
-    groups = machine_groups(n_total, local_size)
+    groups = validate_machine_decomposition(n_total, local_size,
+                                            (machine_spec,))
     acc_dtype = _accum_dtype(x.dtype)
-    local_mean = lax.psum(x.astype(acc_dtype), axis_name, axis_index_groups=groups)
+    # ICI leg: ONE exact grouped reduce, always full precision.
+    local_mean = lax.psum(x.astype(acc_dtype), axis_name,
+                          axis_index_groups=groups)
     local_mean = local_mean / local_size
 
     idx = lax.axis_index(axis_name)
     machine_id = idx // local_size
+    if wire_key is not None:
+        # independent rounding noise per rank
+        wire_key = jax.random.fold_in(wire_key, idx)
     if self_weights is None:
         self_w = jnp.asarray(_self_weights_of(machine_spec),
                              dtype=acc_dtype)[machine_id]
     else:
         self_w = self_weights.astype(acc_dtype)[machine_id]
-    acc = local_mean * self_w
-    for c, cls in enumerate(machine_spec.shift_classes):
+
+    # DCN leg: the machine mean goes on the wire in the PAYLOAD dtype
+    # (exact round-trip at local_size == 1; halves DCN bytes for bf16
+    # params) or compressed; the self term keeps the full-precision mean.
+    wire = local_mean.astype(x.dtype)
+
+    def expand(perm):
         # Machine edge (ms, md) expands to rank pairs (ms*L+j, md*L+j).
-        pairs = [
-            (ms * local_size + j, md * local_size + j)
-            for (ms, md) in cls.perm
-            for j in range(local_size)
-        ]
-        received = lax.ppermute(local_mean, axis_name, pairs)
+        return [(ms * local_size + j, md * local_size + j)
+                for (ms, md) in perm for j in range(local_size)]
+
+    def recv_w(c, cls):
         if class_weights is None:
-            w = jnp.asarray(cls.recv_weights, dtype=acc_dtype)[machine_id]
-        else:
-            w = class_weights[c].astype(acc_dtype)[machine_id]
-        acc = acc + received * w
+            return jnp.asarray(cls.recv_weights, dtype=acc_dtype)[machine_id]
+        return class_weights[c].astype(acc_dtype)[machine_id]
+
+    # Mirror the flat path's class fusion: in-degree-1 machine schedules
+    # with pairwise-disjoint classes (one-peer dynamic rounds — the
+    # schedules the hierarchical compiler emits) collapse to ONE
+    # collective-permute on the DCN, so the whole round is exactly one
+    # grouped all-reduce + one permute.
+    classes = machine_spec.shift_classes
+    if len(classes) > 1:
+        all_pairs = [p for cls in classes for p in cls.perm]
+        srcs = [s for s, _ in all_pairs]
+        dsts = [d for _, d in all_pairs]
+        if len(set(srcs)) == len(srcs) and len(set(dsts)) == len(dsts):
+            merged = tuple(expand(sorted(all_pairs)))
+            if class_weights is None:
+                w_fused = jnp.asarray(
+                    np.sum([cls.recv_weights for cls in classes], axis=0),
+                    dtype=acc_dtype)[machine_id]
+            else:
+                masks = np.zeros((len(classes), machine_spec.size))
+                for c, cls in enumerate(classes):
+                    for _, d in cls.perm:
+                        masks[c, d] = 1.0
+                w_fused = (class_weights.astype(acc_dtype)
+                           * jnp.asarray(masks, acc_dtype)).sum(0)[machine_id]
+            if compress == "int8":
+                q, scale = _wire_quantize_int8(wire, wire_key)
+                rcv = (lax.ppermute(q, axis_name, merged)
+                       .astype(jnp.float32)
+                       * lax.ppermute(scale, axis_name, merged))
+            elif compress == "bf16" and x.dtype != jnp.bfloat16:
+                rcv = _permute_bf16_wire(wire, axis_name, merged)
+            else:
+                rcv = lax.ppermute(wire, axis_name, merged)
+            acc = local_mean * self_w + rcv.astype(acc_dtype) * w_fused
+            return acc.astype(x.dtype)
+
+    received, weights = [], []
+    if compress == "int8":
+        q, scale = _wire_quantize_int8(wire, wire_key)
+        for c, cls in enumerate(classes):
+            pairs = expand(cls.perm)
+            rq = lax.ppermute(q, axis_name, pairs)
+            rs = lax.ppermute(scale, axis_name, pairs)
+            received.append(rq.astype(jnp.float32) * rs)
+            weights.append(recv_w(c, cls))
+    elif compress == "bf16" and x.dtype != jnp.bfloat16:
+        for c, cls in enumerate(classes):
+            received.append(
+                _permute_bf16_wire(wire, axis_name, expand(cls.perm)))
+            weights.append(recv_w(c, cls))
+    else:
+        for c, cls in enumerate(classes):
+            received.append(lax.ppermute(wire, axis_name, expand(cls.perm)))
+            weights.append(recv_w(c, cls))
+    acc = local_mean * self_w
+    for r, w in zip(received, weights):
+        acc = acc + r.astype(acc_dtype) * w
     return acc.astype(x.dtype)

@@ -50,6 +50,8 @@ __all__ = [
     "heal_weights",
     "heal_spec",
     "healed_comm_weights",
+    "machine_dead_mask",
+    "healed_hierarchical_comm_weights",
     "consensus_simulation",
 ]
 
@@ -181,6 +183,36 @@ def healed_comm_weights(specs: Sequence[CommSpec], dead_mask) -> tuple:
         cw, sw = heal_weights(s, dead_mask)
         out.append((jnp.asarray(cw), jnp.asarray(sw)))
     return tuple(out)
+
+
+def machine_dead_mask(dead_mask, local_size: int) -> np.ndarray:
+    """Collapse a RANK-level dead mask to the MACHINE level: a machine is
+    dead when ANY of its ``local_size`` ranks is dead.
+
+    Under the hierarchical exchange the machine is the failure domain:
+    the intra-machine reduce is an exact grouped psum whose program
+    cannot skip a member, so a machine containing a dead rank has a
+    polluted mean and is excised from the inter-machine mixing as a
+    unit (conservative — its surviving ranks keep their machine-local
+    consensus and rejoin with the machine)."""
+    from bluefog_tpu.parallel.collectives import validate_machine_decomposition
+
+    dead = np.asarray(dead_mask, bool).reshape(-1)
+    validate_machine_decomposition(dead.shape[0], local_size)
+    return dead.reshape(-1, int(local_size)).any(axis=1)
+
+
+def healed_hierarchical_comm_weights(machine_specs: Sequence[CommSpec],
+                                     dead_mask, local_size: int) -> tuple:
+    """Healed MACHINE-level weight tables from a RANK-level dead mask —
+    the hierarchical train step's ``comm_weights`` delivery.  The rank
+    mask collapses through :func:`machine_dead_mask` and the machine
+    schedule heals exactly like a flat one; the tables are machine-sized
+    (``[n_classes, n_machines]`` / ``[n_machines]``) so dead ranks and
+    joiners swap in as pure data — zero recompiles, same contract as
+    :func:`healed_comm_weights`."""
+    return healed_comm_weights(machine_specs,
+                               machine_dead_mask(dead_mask, local_size))
 
 
 def consensus_simulation(specs: Sequence[CommSpec], rounds: int,

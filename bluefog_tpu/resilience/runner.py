@@ -38,7 +38,8 @@ from bluefog_tpu.context import BluefogError
 from bluefog_tpu.optim.functional import GuardConfig
 from bluefog_tpu.resilience.detector import FailureDetector
 from bluefog_tpu.resilience.faults import FaultPlan
-from bluefog_tpu.resilience.healing import healed_comm_weights
+from bluefog_tpu.resilience.healing import (healed_comm_weights,
+                                            healed_hierarchical_comm_weights)
 
 __all__ = ["ResilienceEvent", "ResilientResult", "run_resilient"]
 
@@ -107,7 +108,13 @@ def run_resilient(
     ``schedule`` is the list of topology specs backing the step's
     combine (one element for a static topology) — required for healing;
     without it a rollback restores state but the mixing weights stay as
-    passed.  ``checkpointer`` needs ``save(step, state, force=)`` and
+    passed.  For a HIERARCHICAL step (``build_train_step(...,
+    hierarchical=...)``) the schedule is MACHINE-level and the loop
+    detects it via the step's ``hierarchical_local_size`` attribute:
+    the detector keeps watching RANKS, and every heal delivery collapses
+    the rank mask through ``healing.machine_dead_mask`` (a machine with
+    any dead member is excised as a unit) before healing the machine
+    schedule.  ``checkpointer`` needs ``save(step, state, force=)`` and
     ``restore_latest(mesh, like=)`` (the orbax ``Checkpointer``'s
     surface); checkpoint steps store ``{"params", "opt_state", "step"}``.
     ``sleep`` is injectable so tests (and the chaos bench) run backoff
@@ -170,9 +177,20 @@ def run_resilient(
     detector = detector or FailureDetector(n)
     if comm_weights is None:
         comm_weights = train_step.default_comm_weights
+    # a hierarchical step's schedule specs are MACHINE-level; the
+    # detector stays RANK-level, and every heal delivery collapses the
+    # rank mask through the machine failure domain
+    hier_l = getattr(train_step, "hierarchical_local_size", None)
+
+    def heal(dead_mask):
+        if hier_l:
+            return healed_hierarchical_comm_weights(
+                schedule, dead_mask, hier_l)
+        return healed_comm_weights(schedule, dead_mask)
+
     dead = detector.dead_mask()
     if dead.any() and schedule:
-        comm_weights = healed_comm_weights(schedule, dead)
+        comm_weights = heal(dead)
 
     controller = None
     admit_fn = None
@@ -182,6 +200,15 @@ def run_resilient(
             raise ValueError(
                 "run_resilient(elastic=...) needs schedule= — membership "
                 "is a weight re-plan over the topology specs")
+        if hier_l:
+            raise ValueError(
+                "run_resilient(elastic=...) does not drive a hierarchical "
+                "step: the MembershipController anneals RANK-level "
+                "weights while a hierarchical schedule is MACHINE-level. "
+                "Drive membership yourself over the machine schedule "
+                "(elastic.grown_comm_weights / MembershipController on "
+                "the machine specs feed the step's comm_weights as data "
+                "— see tests/test_hierarchical.py) or train flat.")
         # imported here, not at module top: bluefog_tpu.elastic imports
         # resilience.healing, and this module loads as part of the
         # resilience package __init__
@@ -464,7 +491,7 @@ def run_resilient(
                 force_ckpt = False
                 comm_weights = controller.comm_weights()
             elif schedule:
-                comm_weights = healed_comm_weights(schedule, dead)
+                comm_weights = heal(dead)
             backoff = min(
                 guard.backoff_base * guard.backoff_factor ** n_rollbacks,
                 guard.max_backoff)

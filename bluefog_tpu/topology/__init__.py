@@ -57,6 +57,8 @@ from bluefog_tpu.topology.compiler import (  # noqa: F401
     PodSpec,
     Sketch,
     CompiledTopology,
+    CompiledHierarchicalTopology,
     compile_topology,
+    expand_machine_pairs,
     menu_schedules,
 )

@@ -87,7 +87,9 @@ __all__ = [
     "Candidate",
     "CandidateRound",
     "CompiledTopology",
+    "CompiledHierarchicalTopology",
     "candidate_contraction",
+    "expand_machine_pairs",
     "materialize",
     "menu_schedules",
     "compile_topology",
@@ -268,17 +270,24 @@ class PodSpec:
     @classmethod
     def from_telemetry(cls, machines: int, chips_per_machine: int,
                        registry=None, contention: float = 1.0,
+                       link: Optional[str] = None,
                        **kwargs) -> "PodSpec":
         """Build a pod spec calibrated from the LIVE fleet-telemetry
         traffic counters: reads the ``bf_edge_bytes_total{src,dst}``
         family out of the metrics registry
         (:func:`bluefog_tpu.observe.fleet.traffic_snapshot`) and
         routes it into per-link cost multipliers.  With no recorded
-        traffic this is the plain (uncalibrated) spec."""
+        traffic this is the plain (uncalibrated) spec.
+
+        ``link`` filters the snapshot to one billed leg ("dcn"/"ici" —
+        the per-leg labels a hierarchical step records): calibrating a
+        HIERARCHICAL synthesis from ``link="dcn"`` routes only the
+        inter-machine bytes onto the cost model, so cheap intra-machine
+        chatter never masquerades as DCN load."""
         from bluefog_tpu.observe.fleet import traffic_snapshot
 
         base = cls(machines, chips_per_machine, **kwargs)
-        return base.calibrated(traffic_snapshot(registry),
+        return base.calibrated(traffic_snapshot(registry, link=link),
                                contention=contention)
 
 
@@ -681,6 +690,167 @@ class CompiledTopology:
         }
 
 
+# ------------------------------------------------------------------ #
+# hierarchical synthesis: exact ICI reduce inside the machine,
+# decentralized mixing only across DCN
+# ------------------------------------------------------------------ #
+def expand_machine_pairs(pairs: Sequence[Tuple[int, int]],
+                         local_size: int) -> List[Tuple[int, int]]:
+    """Expand MACHINE-level edges to the RANK-level counterpart pairs
+    the hierarchical exchange actually wires (``collectives.
+    hierarchical_neighbor_allreduce``): local rank ``j`` of machine
+    ``ms`` sends to local rank ``j`` of machine ``md``.  Pure host-side
+    mirror of the jax implementation's expansion, so the cost model and
+    the HLO predictions can never disagree with the lowering."""
+    L = int(local_size)
+    return [(ms * L + j, md * L + j)
+            for (ms, md) in pairs for j in range(L)]
+
+
+def _ici_reduce_cost(pod: PodSpec) -> Tuple[float, float]:
+    """(congestion, cost) of the intra-machine exact-mean leg: a ring
+    allreduce of the full payload over each machine's ``L`` chips puts
+    ``2 (L - 1) / L`` payload units on every ICI link (reduce-scatter +
+    all-gather), priced at the most expensive ICI link's calibrated
+    cost.  ``L == 1`` machines have no ICI leg."""
+    L = pod.chips_per_machine
+    if L < 2:
+        return 0.0, 0.0
+    load = 2.0 * (L - 1) / L
+    worst = max(pod.link_cost((pod.torus.coord(r), 1, sign))
+                for r in range(pod.size) for sign in (+1, -1))
+    return load, load * worst
+
+
+def _machine_pod(pod: PodSpec) -> PodSpec:
+    """The inter-machine graph as a smaller pod for the existing sketch
+    search: ``machines x 1``, DCN-priced axis 0 only.  Calibrated
+    DCN-link overrides carry over machine-wise (the max over the
+    machine's chip lanes — a congested lane throttles the whole
+    machine exchange, since the counterpart expansion pins every lane
+    into the same round)."""
+    agg: Dict[LinkKey, float] = {}
+    for (coord, axis, sign), mult in pod.link_cost_overrides:
+        if axis != 0:
+            continue  # ICI overrides are priced by _ici_reduce_cost
+        key = ((coord[0], 0), 0, sign)
+        agg[key] = max(agg.get(key, 1.0), mult)
+    return PodSpec(pod.machines, 1, ici_cost=pod.ici_cost,
+                   dcn_cost=pod.dcn_cost,
+                   link_cost_overrides=tuple(sorted(agg.items())))
+
+
+def _hierarchical_score(pod: PodSpec,
+                        machine_schedule: Sequence[DynamicTopology],
+                        eps: float = 1e-3) -> Dict[str, float]:
+    """Full-pod score of a two-level schedule, same ``cost_to_consensus``
+    schema as the flat scorer: each round pays the ICI reduce leg PLUS
+    the DCN leg of its counterpart-expanded machine edges (max link
+    load x calibrated cost, dimension-ordered routing — identical
+    machinery to the flat rounds it competes against).
+
+    Contraction is the MACHINE schedule's: the expanded round mixes by
+    ``kron(W_machine, J_L / L)``, whose non-DC spectrum is the machine
+    matrix's non-DC spectrum plus exact zeros (the intra-machine modes
+    die in the first exact mean), so rounds-to-consensus is governed by
+    the inter-machine mixing alone."""
+    L = pod.chips_per_machine
+    ici_cong, ici_cost = _ici_reduce_cost(pod)
+    congs, costs = [], []
+    for r in machine_schedule:
+        pairs = expand_machine_pairs(list(r.edges), L)
+        loads = link_loads(pairs, pod.torus)
+        dcn_cong = max(loads.values(), default=0.0)
+        dcn_cost = max((load * pod.link_cost(k)
+                        for k, load in loads.items()), default=0.0)
+        congs.append(max(ici_cong, dcn_cong))
+        costs.append(ici_cost + dcn_cost)
+    sigma = consensus_contraction(machine_schedule)
+    return _score_fields(congs, costs, sigma, eps)
+
+
+@dataclasses.dataclass
+class CompiledHierarchicalTopology:
+    """A synthesized TWO-LEVEL schedule: ``local_size`` names the exact
+    intra-machine reduce (the ``axis_index_groups`` width) and
+    ``machine_schedule`` the decentralized inter-machine rounds — feed
+    ``build_train_step(schedule=machine_schedule,
+    hierarchical=local_size)`` unchanged.  ``score`` is the full-pod
+    ``cost_to_consensus`` (:func:`_hierarchical_score`);
+    ``predicted_collectives`` states the per-round lowering the HLO
+    tests hold the real program to: exactly ONE grouped all-reduce
+    (the ICI leg) plus the machine permutes, each permute carrying the
+    full payload across DCN."""
+
+    local_size: int
+    machine_schedule: List[DynamicTopology]
+    score: Dict[str, float]
+    name: str
+    pod: PodSpec
+    report: Dict[str, Dict[str, float]]
+    search: Dict[str, float]
+
+    @property
+    def schedule(self) -> List[DynamicTopology]:
+        """Alias: the specs a train step consumes (machine-level)."""
+        return self.machine_schedule
+
+    def predicted_collectives(self, payload_bytes: float) -> Dict:
+        """Per round: 1 grouped all-reduce over every machine's chips
+        plus the machine-class permutes (the flat class-fusion rule
+        applied at machine level — the counterpart expansion preserves
+        in-degree-1-ness, so a fused machine round is one
+        ``lax.ppermute`` on the wire)."""
+        per_round = []
+        for r in self.machine_schedule:
+            pairs = [p for cls in r.shift_classes for p in cls.perm]
+            srcs = [s for s, _ in pairs]
+            dsts = [d for _, d in pairs]
+            fused = (len(set(srcs)) == len(srcs)
+                     and len(set(dsts)) == len(dsts))
+            per_round.append({
+                "all_reduces": 1,
+                "permutes": 1 if fused else len(r.shift_classes),
+                "bytes_per_permute": float(payload_bytes),
+            })
+        return {
+            "permutes_per_period": sum(r["permutes"] for r in per_round),
+            "bytes_per_period": float(sum(
+                r["permutes"] * r["bytes_per_permute"]
+                for r in per_round)),
+            "all_reduces_per_period": len(per_round),
+            "all_reduce_groups": self.pod.machines,
+            "all_reduce_group_size": self.local_size,
+            "bytes_per_all_reduce": float(payload_bytes),
+            "per_round": per_round,
+        }
+
+    def as_json(self) -> Dict:
+        return {
+            "pod": {
+                "machines": self.pod.machines,
+                "chips_per_machine": self.pod.chips_per_machine,
+                "ici_cost": self.pod.ici_cost,
+                "dcn_cost": self.pod.dcn_cost,
+                "calibrated_links": len(self.pod.link_cost_overrides),
+            },
+            "winner": self.name,
+            "local_size": self.local_size,
+            "score": self.score,
+            "report": self.report,
+            "search": self.search,
+            "machine_schedule": [
+                {
+                    "edges": [[int(s), int(d), float(w)] for (s, d), w in
+                              zip(r.edges, r.edge_weight_values)],
+                    "self_weights": [float(w)
+                                     for w in r.self_weight_values],
+                }
+                for r in self.machine_schedule
+            ],
+        }
+
+
 def menu_schedules(pod: PodSpec) -> Dict[str, List[DynamicTopology]]:
     """The FIXED menu the compiler competes against — the schedules a
     round-4 operator could hand-pick (``default_pod_schedule``'s
@@ -702,7 +872,8 @@ def menu_schedules(pod: PodSpec) -> Dict[str, List[DynamicTopology]]:
 
 def compile_topology(pod: PodSpec, sketch: Optional[Sketch] = None,
                      eps: float = 1e-3,
-                     verbose: bool = False) -> CompiledTopology:
+                     verbose: bool = False,
+                     hierarchical: bool = False):
     """Synthesize the mixing schedule for ``pod``: seed the sketch's
     shift families, weight-optimize each candidate (spectral-gap
     objective), hill-climb with Swing-style mutations, prune with the
@@ -710,7 +881,39 @@ def compile_topology(pod: PodSpec, sketch: Optional[Sketch] = None,
     rounds-to-consensus is never below one period), and emit the
     winner as ``DynamicTopology`` rounds scored by the generic matrix
     machinery (the Fourier search score and the materialized-matrix
-    score must agree; the tests assert it)."""
+    score must agree; the tests assert it).
+
+    ``hierarchical=True`` synthesizes the TWO-LEVEL decomposition
+    instead: the inter-machine graph becomes a smaller
+    ``machines x 1`` pod (calibrated DCN overrides carried over
+    machine-wise) driven through the SAME sketch search, and the winner
+    is rescored on the full pod by :func:`_hierarchical_score` — ICI
+    reduce leg plus counterpart-expanded DCN leg per round, contraction
+    from the machine matrix.  Returns a
+    :class:`CompiledHierarchicalTopology` whose ``report`` keeps the
+    machine-level search entries under ``machine:*`` and full-pod flat
+    menu scores under ``menu:*`` for the apples-to-apples audit."""
+    if hierarchical:
+        if pod.machines < 2:
+            raise ValueError(
+                "hierarchical synthesis needs machines >= 2 — a "
+                "single-machine pod has no DCN leg to decentralize")
+        inner = compile_topology(_machine_pod(pod), sketch, eps=eps,
+                                 verbose=verbose)
+        score = _hierarchical_score(pod, inner.schedule, eps=eps)
+        report = {f"machine:{k}": v for k, v in inner.report.items()}
+        report["hierarchical"] = score
+        for name, sched in menu_schedules(pod).items():
+            report[f"menu:{name}"] = pod.score(sched, eps=eps)
+        if verbose:
+            print(f"[compile_topology] hierarchical "
+                  f"(L={pod.chips_per_machine}, {inner.name}): "
+                  f"cost_to_consensus={score['cost_to_consensus']:.3f}")
+        return CompiledHierarchicalTopology(
+            local_size=pod.chips_per_machine,
+            machine_schedule=inner.schedule, score=score,
+            name=f"hier:{inner.name}", pod=pod, report=report,
+            search=inner.search)
     sketch = sketch or Sketch()
     t0 = time.perf_counter()
     axes = pod.axes
@@ -851,6 +1054,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "(the bf_edge_bytes_total shape)")
     ap.add_argument("--contention", type=float, default=1.0,
                     help="calibration strength (see PodSpec.calibrated)")
+    ap.add_argument("--hierarchical", action="store_true",
+                    help="synthesize the two-level schedule: exact ICI "
+                         "reduce per machine, compiled mixing across "
+                         "DCN only")
     ap.add_argument("--emit", choices=("json", "summary"),
                     default="summary")
     args = ap.parse_args(argv)
@@ -865,7 +1072,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             contention=args.contention)
     sketch = Sketch(max_period=args.max_period,
                     max_degree=args.max_degree)
-    compiled = compile_topology(pod, sketch, eps=args.eps)
+    compiled = compile_topology(pod, sketch, eps=args.eps,
+                                hierarchical=args.hierarchical)
     if args.emit == "json":
         print(json.dumps(compiled.as_json(), indent=1, sort_keys=True))
     else:

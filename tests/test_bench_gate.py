@@ -182,6 +182,58 @@ def test_chaos_bench_defaults_and_baseline():
     assert "rejoin.post_rejoin_floor" in head
 
 
+# --------------------------------------------------------------------- #
+# hierarchical-exchange baseline (ISSUE 11): the 8B audit's flat-vs-
+# two-level record joins the gate flow — DCN bytes/step is a gated
+# lower-is-better headline, so a schedule change that silently re-
+# inflates the inter-machine wire fails the compare
+# --------------------------------------------------------------------- #
+@pytest.mark.hier
+def test_hierarchical_audit_baseline_is_committed_and_defended():
+    """The committed r14 record carries the hierarchical audit with
+    every machine-checked claim true: DCN bytes/step halved vs the
+    flat exchange at the same guard+health+int8 config, tp overlap
+    still defended, cost-model overhead bounded, and the r11-layout
+    epilogue record not regressed."""
+    base = _load(os.path.join("benchmarks",
+                              "llama_8b_measured_r14.json"))
+    hier = base["hierarchical"]
+    assert all(v is True for k, v in hier["claims"].items()
+               if isinstance(v, bool)), hier["claims"]
+    assert hier["claims"]["dcn_bytes_ratio"] <= 0.75
+    assert (hier["hierarchical"]["dcn_bytes_per_step"]
+            < hier["flat"]["dcn_bytes_per_step"])
+    assert base["epilogue"]["claims"]["cost_bytes_not_above_r11"] is True
+    # the gate sees the hierarchical headline fields
+    from bluefog_tpu.benchutil import bench_headline
+
+    head = bench_headline(base)
+    assert "hierarchical.dcn_bytes_per_step" in head
+    assert "hierarchical.tp_overlap_fraction" in head
+
+
+@pytest.mark.hier
+def test_gate_catches_dcn_byte_regression(capsys):
+    """A schedule change that re-inflates the inter-machine wire (DCN
+    bytes/step back up toward the flat exchange) fails the gate —
+    lower is better for dcn_bytes_per_step."""
+    from bluefog_tpu.benchutil import bench_compare
+
+    base = _load(os.path.join("benchmarks",
+                              "llama_8b_measured_r14.json"))
+    regressed = copy.deepcopy(base)
+    regressed["hierarchical"]["dcn_bytes_per_step"] *= 2.0
+    regressed["hierarchical"]["tp_overlap_fraction"] *= 0.5
+    ok, rows = bench_compare(regressed, base, tolerance=0.25)
+    assert ok is False
+    bad = {r["name"] for r in rows if r["regressed"]}
+    assert "hierarchical.dcn_bytes_per_step" in bad
+    assert "hierarchical.tp_overlap_fraction" in bad
+    # ... and the committed record gates clean against itself
+    ok2, _ = bench_compare(base, base)
+    assert ok2 is True
+
+
 def test_gate_catches_rejoin_regression(capsys):
     """A blown consensus floor / collapsed throughput recovery after
     rejoin fails the gate."""

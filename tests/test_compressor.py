@@ -190,10 +190,19 @@ def test_functional_compress_invalid_combinations_rejected():
     with _pytest.raises(ValueError, match="compress"):
         F.build_train_step(loss, optax.sgd(0.1), mesh,
                            comm_mode="gradient_allreduce", compress="int8")
+    # int8 + hierarchical is no longer a rejection: the quantizer rides
+    # the DCN leg only (the ICI reduce stays full precision), so the
+    # build succeeds given a MACHINE-level topology
+    mspec = uniform_topology_spec(RingGraph(4))
+    step = F.build_train_step(loss, optax.sgd(0.1), mesh, comm_mode="cta",
+                              topology=mspec, hierarchical_local_size=2,
+                              compress="int8")
+    assert step.hierarchical_local_size == 2
+    # but an unknown codec still rejects on the hierarchical path too
     with _pytest.raises(ValueError, match="compress"):
         F.build_train_step(loss, optax.sgd(0.1), mesh, comm_mode="cta",
-                           topology=spec, hierarchical_local_size=2,
-                           compress="int8")
+                           topology=mspec, hierarchical_local_size=2,
+                           compress="fp8")
 
 
 # ------------------------------------------- bf16 wire compression

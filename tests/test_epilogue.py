@@ -79,6 +79,19 @@ def _weighted_schedule():
     return out
 
 
+def _machine_ring():
+    """Non-uniform MACHINE-level ring (8 ranks as 4 machines of 2): the
+    hierarchical matrix's inter-machine schedule, weighted so XLA
+    cannot factor the combine (same reasoning as ``_weighted_ring``)."""
+    m = N // 2
+    W = np.zeros((m, m))
+    for r in range(m):
+        W[(r - 1) % m, r] = 0.3
+        W[(r + 1) % m, r] = 0.1
+        W[r, r] = 0.6
+    return Topology.from_weight_matrix(W)
+
+
 def _problem():
     base = {"w1": jnp.asarray(np.random.RandomState(7).randn(4, 4) * 0.3),
             "b1": jnp.zeros((4,)),
@@ -182,6 +195,20 @@ def _matrix():
     cases.append(dict(comm_mode="atc", overlap="bucketed", guard=True,
                       health=True, compress=None,
                       schedule=_weighted_schedule()))
+    # hierarchical x {guard, health, int8, bucketed overlap}: the
+    # two-level exchange (4 machines of 2) through every epilogue
+    # feature, fused-vs-unfused parity like the flat matrix
+    mring = _machine_ring()
+    for comm_mode, overlap, guard, health, compress in (
+            ("cta", "none", False, False, None),
+            ("cta", "bucketed", True, True, None),
+            ("atc", "none", True, False, None),
+            ("atc", "bucketed", False, True, None),
+            ("cta", "bucketed", True, True, "int8"),
+            ("atc", "none", True, True, "int8")):
+        cases.append(dict(comm_mode=comm_mode, overlap=overlap,
+                          guard=guard, health=health, compress=compress,
+                          topology=mring, hierarchical=2))
     return cases
 
 
@@ -191,7 +218,8 @@ def _case_id(c):
         "guard" if c["guard"] else "noguard",
         "health" if c["health"] else "nohealth",
         c["compress"] or "fp",
-        "sched" if "schedule" in c else "static"])
+        "hier" if "hierarchical" in c
+        else ("sched" if "schedule" in c else "static")])
 
 
 @pytest.mark.perf
@@ -261,6 +289,55 @@ def test_uniform_static_cta_guarded_bit_identical(monkeypatch):
     kwargs = dict(comm_mode="cta", topology=spec)
     step_u = _build(monkeypatch, True, **kwargs)
     step_g = _build(monkeypatch, True, guard=F.GuardConfig(), **kwargs)
+    params, ostate = _state(mesh)
+    p2, o2 = params, ostate
+    for s in range(5):
+        batch = _batch(mesh, s)
+        params, ostate, loss = step_u(params, ostate, batch, jnp.int32(s))
+        p2, o2, loss2, skipped = step_g(p2, o2, batch, jnp.int32(s),
+                                        step_g.default_comm_weights)
+        np.testing.assert_array_equal(np.asarray(skipped),
+                                      np.zeros(N, np.int32))
+    for a, b in zip(jax.tree.leaves((params, ostate, loss)),
+                    jax.tree.leaves((p2, o2, loss2))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.hier
+def test_hierarchical_single_rank_machines_bitwise_flat(monkeypatch):
+    """The L == 1 degeneracy contract: with every machine holding ONE
+    rank the two-level decomposition IS the flat exchange — singleton
+    psum is the identity, counterpart expansion reproduces the rank
+    permutes, the int8 wire path folds the same per-rank key — so the
+    trajectories are bit-identical, full precision and int8 alike."""
+    mesh = _mesh()
+    ring = _weighted_ring()
+    for compress in (None, "int8"):
+        kw = dict(comm_mode="cta", topology=ring)
+        if compress:
+            kw["compress"] = compress
+        flat = _build(monkeypatch, True, **kw)
+        hier = _build(monkeypatch, True, hierarchical=1, **kw)
+        pf, of, lf, _, _ = _run(flat, mesh, guarded=False, steps=4)
+        ph, oh, lh, _, _ = _run(hier, mesh, guarded=False, steps=4)
+        np.testing.assert_array_equal(np.asarray(lf), np.asarray(lh))
+        for a, b in zip(jax.tree.leaves((pf, of)),
+                        jax.tree.leaves((ph, oh))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.hier
+def test_hierarchical_guarded_matches_unguarded_bitwise(monkeypatch):
+    """Guard + hierarchical composes (the rejection this PR lifts):
+    the guarded build carries the MACHINE-level weight tables as traced
+    operands exactly like the unguarded fused build, so on a clean run
+    the two-level trajectories are bit-identical and no step skips."""
+    mesh = _mesh()
+    kwargs = dict(comm_mode="cta", topology=_machine_ring(),
+                  hierarchical=2)
+    step_u = _build(monkeypatch, True, **kwargs)
+    step_g = _build(monkeypatch, True, guard=F.GuardConfig(), **kwargs)
+    assert step_g.hierarchical_local_size == 2
     params, ostate = _state(mesh)
     p2, o2 = params, ostate
     for s in range(5):

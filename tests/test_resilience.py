@@ -309,7 +309,9 @@ def test_guard_validation():
     with pytest.raises(ValueError, match="push_sum"):
         F.build_train_step(_loss_fn, _OPT, mesh, comm_mode="push_sum",
                            topology=spec, guard=F.GuardConfig())
-    with pytest.raises(ValueError, match="hierarchical"):
+    # guard + hierarchical composes now; what must still fail loudly is
+    # a RANK-sized spec passed where the machine schedule belongs
+    with pytest.raises(ValueError, match="machine"):
         F.build_train_step(_loss_fn, _OPT, mesh, comm_mode="cta",
                            topology=spec, hierarchical_local_size=2,
                            guard=F.GuardConfig())
