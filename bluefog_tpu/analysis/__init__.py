@@ -1,0 +1,125 @@
+"""Static contract checker for the zero-recompile / traced-weights
+invariants.
+
+Every subsystem since the guarded-step work rests on two framework
+contracts, and this package checks them mechanically before anything
+executes:
+
+* **weights-as-data** — comm weights, dead/membership masks, and health
+  tables reach a built program as *traced operands*
+  (``F.comm_weight_inputs``-shaped invars), never as closed-over
+  constants.  A baked weight table means healing / elastic membership /
+  topology hot-swap would RECOMPILE — the production failure mode the
+  whole healing discipline exists to prevent.
+* **collective contract** — the lowered HLO contains exactly the
+  collectives the schedule predicts (``predicted_collectives``):
+  permute count, payload bytes, grouped-all-reduce structure.  The
+  TACCL-style agreement between declared sketch and emitted algorithm.
+
+Two complementary passes:
+
+* :mod:`bluefog_tpu.analysis.jaxpr_check` — semantic: builds the real
+  programs (the ``build_train_step`` parity matrix, serving resident
+  programs) and walks their ClosedJaxprs/HLO for baked weight tables,
+  dead weight operands, ``lax.cond`` over per-rank-divergent
+  predicates, and predicted-vs-lowered collective mismatches.
+* :mod:`bluefog_tpu.analysis.lint` — syntactic: an AST lint over the
+  repo with the project-specific rules (env reads outside ``config``,
+  host syncs inside jitted bodies, Python ``if`` on traced values,
+  weight-matrix construction bypassing the shared row-stochastic
+  helpers, unseeded benchmark randomness, unregistered pytest markers).
+
+Vetted exceptions live in the committed ``baseline.txt`` next to this
+file — every suppression is explicit, keyed on stable
+``rule path::symbol`` triples (no line numbers, so unrelated edits
+never churn it), and carries a justifying comment.
+
+CLI: ``python -m bluefog_tpu.analysis`` (installed as ``bfcheck``)
+runs both passes and exits nonzero on any unsuppressed finding; see
+``docs/analysis.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Iterable, List, Sequence, Tuple
+
+__all__ = ["Finding", "baseline_path", "load_baseline",
+           "split_suppressed", "format_findings", "default_root"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One contract violation.
+
+    ``key()`` deliberately omits the line number: a baseline entry must
+    keep suppressing the same (rule, file, symbol) finding across
+    unrelated edits, and must NOT silently absorb a second finding of
+    the same rule elsewhere in the file.
+    """
+
+    rule: str      # e.g. "env-read-outside-config"
+    path: str      # repo-relative posix path, or the program name for
+                   # jaxpr findings (e.g. "step[atc,guard,health]")
+    line: int      # 1-based; 0 when not tied to source text
+    symbol: str    # enclosing function/class qualname, or the checked
+                   # sub-contract for jaxpr findings
+    message: str
+
+    def key(self) -> str:
+        return f"{self.rule} {self.path}::{self.symbol}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.symbol}: {self.message}"
+
+
+def baseline_path() -> str:
+    """The committed baseline-suppression file shipped with the
+    package."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.txt")
+
+
+def load_baseline(path: str = None) -> List[str]:
+    """Suppression keys from a baseline file: one ``rule path::symbol``
+    per line; blank lines and ``#`` comments (full-line or trailing)
+    ignored.  Missing file = empty baseline."""
+    if path is None:
+        path = baseline_path()
+    keys: List[str] = []
+    if not os.path.exists(path):
+        return keys
+    with open(path) as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if line:
+                keys.append(line)
+    return keys
+
+
+def split_suppressed(
+        findings: Iterable[Finding],
+        baseline: Sequence[str]) -> Tuple[List[Finding], List[Finding]]:
+    """``(active, suppressed)`` — a finding is suppressed iff its
+    ``key()`` appears verbatim in the baseline."""
+    allowed = set(baseline)
+    active, suppressed = [], []
+    for f in findings:
+        (suppressed if f.key() in allowed else active).append(f)
+    return active, suppressed
+
+
+def format_findings(findings: Sequence[Finding]) -> str:
+    return "\n".join(f.render() for f in findings)
+
+
+def default_root() -> str:
+    """Repo root to scan: the cwd when it holds a ``pyproject.toml``
+    (the normal checkout invocation), else the tree this package was
+    imported from."""
+    if os.path.exists(os.path.join(os.getcwd(), "pyproject.toml")):
+        return os.getcwd()
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))

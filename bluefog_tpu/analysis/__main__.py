@@ -1,0 +1,80 @@
+"""``python -m bluefog_tpu.analysis`` / the ``bfcheck`` console
+script: run both analyzer passes and exit nonzero on any unsuppressed
+finding.
+
+Order matters: the host platform must be configured for the 8-device
+sweep BEFORE anything imports jax, so :func:`main` calls
+``config.configure_host_platform`` first and defers every jax-touching
+import until after it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from bluefog_tpu.analysis import (Finding, default_root, format_findings,
+                                  load_baseline, split_suppressed)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bfcheck",
+        description="bluefog_tpu static contract checker: AST lint + "
+                    "jaxpr/HLO sweep of the zero-recompile / "
+                    "traced-weights invariants")
+    parser.add_argument("--root", default=None,
+                        help="repo root to scan (default: cwd when it "
+                             "holds pyproject.toml, else the installed "
+                             "package's tree)")
+    parser.add_argument("--baseline", default=None,
+                        help="suppression file (default: the committed "
+                             "bluefog_tpu/analysis/baseline.txt)")
+    parser.add_argument("--no-lint", action="store_true",
+                        help="skip the AST lint pass")
+    parser.add_argument("--no-jaxpr", action="store_true",
+                        help="skip the jaxpr/HLO sweep (the slow pass: "
+                             "builds every train-step variant on an "
+                             "8-device host mesh)")
+    parser.add_argument("--no-serving", action="store_true",
+                        help="within the jaxpr sweep, skip the serving "
+                             "resident programs")
+    parser.add_argument("--list-baseline", action="store_true",
+                        help="print the active suppression keys and "
+                             "exit")
+    args = parser.parse_args(argv)
+
+    baseline = load_baseline(args.baseline)
+    if args.list_baseline:
+        for key in baseline:
+            print(key)
+        return 0
+
+    findings: List[Finding] = []
+    if not args.no_lint:
+        from bluefog_tpu.analysis.lint import run_lint
+
+        root = args.root or default_root()
+        findings += run_lint(root)
+
+    if not args.no_jaxpr:
+        # the sweep traces on an 8-device host mesh; set the platform
+        # up before jax initializes (no-op if the user already did)
+        from bluefog_tpu import config as bfconfig
+
+        bfconfig.configure_host_platform()
+        from bluefog_tpu.analysis.jaxpr_check import run_sweep
+
+        findings += run_sweep(include_serving=not args.no_serving)
+
+    active, suppressed = split_suppressed(findings, baseline)
+    if active:
+        print(format_findings(active))
+    print(f"bfcheck: {len(active)} finding(s), "
+          f"{len(suppressed)} baseline-suppressed", file=sys.stderr)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,533 @@
+"""AST lint: the repo-specific rules no generic linter knows.
+
+Each rule encodes a convention this codebase already enforces by review
+and by scattered tests; the lint makes them mechanical:
+
+``env-read-outside-config``
+    ``os.environ`` / ``os.getenv`` anywhere in ``bluefog_tpu/`` outside
+    ``config.py``.  Every knob goes through one audited accessor module
+    (PR-1 discipline) so ``docs/env_variables.md`` can stay the single
+    source of truth and tests can monkeypatch one seam.
+``host-sync-in-jit``
+    ``float(x)`` / ``.item()`` / ``np.asarray`` / ``np.array`` inside a
+    function that gets traced (``jax.jit`` / ``shard_map`` /
+    ``lax.cond`` / ``lax.switch`` / ``lax.scan`` operands, and their
+    nested defs).  On a traced value these force a device sync or a
+    tracer leak — the classic silent-latency bug.
+``python-if-on-traced``
+    Python ``if`` whose test reads a parameter of a traced function.
+    Branching on a tracer either crashes (ConcretizationTypeError) or —
+    worse — silently bakes one branch per compile, the exact
+    recompile-on-topology-change failure the weights-as-data contract
+    exists to prevent.
+``weight-matrix-bypass``
+    Assigning a ``*comm_weights``-style name from a raw ndarray
+    constructor outside the modules that own weight construction
+    (marked ``_WEIGHT_AUTHORITY = True``).  Hand-rolled weight tables
+    skip the row-stochastic normalization + shape contract of the
+    shared helpers (``topology.spec`` / ``resilience.healing``).
+``unseeded-randomness``
+    Legacy global-state ``np.random.*`` draws in ``benchmarks/``.
+    Benchmark numbers must replay bit-identically; every script
+    threads an explicit ``default_rng(seed)`` / ``RandomState(seed)``.
+``unregistered-pytest-marker``
+    ``pytest.mark.<name>`` in ``tests/`` not declared in
+    ``pyproject.toml`` — with ``--strict-markers`` ambitions, a typo'd
+    marker silently deselects tests.
+
+Pure-syntactic by design: no imports of the scanned modules, so the
+lint runs in milliseconds and can't be confused by import-time side
+effects.  The semantic complement (building real programs and walking
+their jaxprs) is :mod:`bluefog_tpu.analysis.jaxpr_check`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, List, Optional, Set
+
+from bluefog_tpu.analysis import Finding
+
+__all__ = ["run_lint", "lint_file", "registered_markers",
+           "BUILTIN_MARKERS", "WEIGHT_NAME_RE", "WEIGHT_HELPERS"]
+
+# --------------------------------------------------------------------- #
+# shared vocabulary
+# --------------------------------------------------------------------- #
+
+# entry points whose function operands are traced by jax
+_TRACING_CALLS = {
+    "jit", "shard_map", "pmap", "vmap", "grad", "value_and_grad",
+    "remat", "checkpoint", "custom_vjp", "custom_jvp", "scan",
+    "while_loop", "fori_loop", "cond", "switch", "named_call",
+}
+
+# names that count as a host-sync when called on (potentially) traced
+# values inside a traced scope
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_HOST_SYNC_NP_FNS = {"asarray", "array"}
+
+# legacy global-state numpy.random entry points (everything that is not
+# an explicit generator/seed-container constructor draws from the
+# shared hidden RandomState)
+_SEEDED_RANDOM_OK = {
+    "default_rng", "RandomState", "SeedSequence", "Generator",
+    "PCG64", "Philox", "MT19937", "BitGenerator",
+}
+
+# markers pytest itself defines — always registered
+BUILTIN_MARKERS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast",
+}
+
+# a binding whose last path component matches this is "a comm-weight
+# table" for the bypass rule
+WEIGHT_NAME_RE = re.compile(
+    r"(^|_)(comm|class|self|recv|mix)_weights?$")
+
+# sanctioned constructors: any call to one of these anywhere in the RHS
+# means the value came through the shared row-stochastic machinery
+WEIGHT_HELPERS = {
+    "comm_weight_inputs", "default_comm_weights", "weights_for_round",
+    "healed_comm_weights", "healed_hierarchical_comm_weights",
+    "class_recv_weights", "self_weight_vector", "self_weights_of",
+    "push_sum_weights", "grow_comm_weights", "row_stochastic",
+    "neighbor_weights", "hierarchical_comm_weights",
+}
+
+# raw ndarray constructors that build a table from scratch
+_RAW_CONSTRUCTORS = {
+    "array", "asarray", "ones", "zeros", "full", "eye", "stack",
+    "concatenate", "tile", "repeat", "ones_like", "zeros_like",
+    "full_like",
+}
+
+
+def _last_attr(node: ast.expr) -> Optional[str]:
+    """Terminal identifier of a Name / dotted Attribute chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _dotted(node: ast.expr) -> str:
+    """Best-effort dotted path ("jax.lax.cond") for a Name/Attribute
+    chain; "" when the chain includes calls/subscripts."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class _ScopeTracker(ast.NodeVisitor):
+    """Base visitor that maintains the enclosing-definition qualname,
+    so findings carry a stable ``symbol``."""
+
+    def __init__(self) -> None:
+        self.scope: List[str] = []
+
+    @property
+    def symbol(self) -> str:
+        return ".".join(self.scope) if self.scope else "<module>"
+
+    def _visit_scoped(self, node) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_FunctionDef = _visit_scoped
+    visit_AsyncFunctionDef = _visit_scoped
+    visit_ClassDef = _visit_scoped
+
+
+# --------------------------------------------------------------------- #
+# rule: env-read-outside-config
+# --------------------------------------------------------------------- #
+
+class _EnvReadVisitor(_ScopeTracker):
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self.path = path
+        self.findings: List[Finding] = []
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr == "environ" and _dotted(node) == "os.environ":
+            self.findings.append(Finding(
+                "env-read-outside-config", self.path, node.lineno,
+                self.symbol,
+                "os.environ accessed directly; route through a "
+                "bluefog_tpu.config accessor (or "
+                "config.environ_passthrough for whole-env reads)"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _dotted(node.func) in ("os.getenv", "os.environb"):
+            self.findings.append(Finding(
+                "env-read-outside-config", self.path, node.lineno,
+                self.symbol,
+                "os.getenv bypasses bluefog_tpu.config; add an "
+                "accessor there instead"))
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------- #
+# rules: host-sync-in-jit + python-if-on-traced
+# --------------------------------------------------------------------- #
+
+def _collect_path_callbacks(tree: ast.AST) -> Set[str]:
+    """Function names passed (by reference) as the callback of a
+    ``tree_map_with_path`` / ``tree_flatten_with_path`` style call.
+    Their FIRST parameter is the static pytree key path — not a traced
+    value — so the if-on-traced rule must not consider it."""
+    names: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Call(self, node: ast.Call) -> None:
+            tail = _last_attr(node.func)
+            if tail and tail.endswith("_with_path") and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                names.add(node.args[0].id)
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return names
+
+
+def _collect_traced_names(tree: ast.AST) -> Set[str]:
+    """Names of module-level/inner functions handed to a tracing entry
+    point by reference: ``jax.jit(step)``, ``shard_map(body, ...)``,
+    ``lax.cond(p, true_fn, false_fn, x)``, ``lax.switch(i, [f, g])``."""
+    traced: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Call(self, node: ast.Call) -> None:
+            tail = _last_attr(node.func)
+            if tail in _TRACING_CALLS:
+                operands: List[ast.expr] = list(node.args)
+                for kw in node.keywords:
+                    if kw.arg in ("f", "fun", "body_fun", "cond_fun",
+                                  "true_fun", "false_fun"):
+                        operands.append(kw.value)
+                for arg in operands:
+                    if isinstance(arg, ast.Name):
+                        traced.add(arg.id)
+                    elif isinstance(arg, (ast.List, ast.Tuple)):
+                        for el in arg.elts:
+                            if isinstance(el, ast.Name):
+                                traced.add(el.id)
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return traced
+
+
+def _has_tracing_decorator(node) -> bool:
+    for dec in node.decorator_list:
+        target = dec
+        # @partial(jax.jit, ...) / @functools.partial(shard_map, ...)
+        if isinstance(dec, ast.Call) and _last_attr(dec.func) == "partial" \
+                and dec.args:
+            target = dec.args[0]
+        if isinstance(target, ast.Call):  # @jax.jit(static_argnums=...)
+            target = target.func
+        if _last_attr(target) in _TRACING_CALLS:
+            return True
+    return False
+
+
+class _TracedBodyVisitor(_ScopeTracker):
+    """Walks traced function bodies flagging host syncs and Python
+    ``if`` over parameters.  ``traced_depth`` > 0 while inside any
+    traced def (nested defs inherit tracedness — jax traces through
+    them)."""
+
+    def __init__(self, path: str, traced_names: Set[str],
+                 path_callbacks: Set[str] = frozenset()) -> None:
+        super().__init__()
+        self.path = path
+        self.traced_names = traced_names
+        self.path_callbacks = path_callbacks
+        self.traced_depth = 0
+        self.param_stack: List[Set[str]] = []
+        self.findings: List[Finding] = []
+
+    # -- scope management ------------------------------------------- #
+
+    def _function(self, node) -> None:
+        is_traced = (self.traced_depth > 0
+                     or node.name in self.traced_names
+                     or _has_tracing_decorator(node))
+        args = node.args
+        positional = args.posonlyargs + args.args
+        if node.name in self.path_callbacks and positional:
+            positional = positional[1:]  # key path: static, not traced
+        params = {a.arg for a in positional + args.kwonlyargs}
+        if args.vararg:
+            params.add(args.vararg.arg)
+        params.discard("self")
+        self.scope.append(node.name)
+        if is_traced:
+            self.traced_depth += 1
+            self.param_stack.append(params)
+        self.generic_visit(node)
+        if is_traced:
+            self.traced_depth -= 1
+            self.param_stack.pop()
+        self.scope.pop()
+
+    visit_FunctionDef = _function
+    visit_AsyncFunctionDef = _function
+
+    # -- host syncs -------------------------------------------------- #
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.traced_depth > 0:
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "float" and node.args \
+                    and not isinstance(node.args[0], ast.Constant):
+                self.findings.append(Finding(
+                    "host-sync-in-jit", self.path, node.lineno,
+                    self.symbol,
+                    "float() on a traced value forces a device sync "
+                    "(use jnp/astype to stay on device)"))
+            elif isinstance(f, ast.Attribute) and f.attr == "item":
+                self.findings.append(Finding(
+                    "host-sync-in-jit", self.path, node.lineno,
+                    self.symbol,
+                    ".item() inside a traced function blocks on device "
+                    "transfer"))
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr in _HOST_SYNC_NP_FNS \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in _NUMPY_ALIASES:
+                self.findings.append(Finding(
+                    "host-sync-in-jit", self.path, node.lineno,
+                    self.symbol,
+                    f"np.{f.attr}() materializes on host inside a "
+                    "traced function (use jnp)"))
+        self.generic_visit(node)
+
+    # -- Python if over traced parameters ---------------------------- #
+
+    def visit_If(self, node: ast.If) -> None:
+        if self.traced_depth > 0 and self.param_stack:
+            params = self.param_stack[-1]
+            for name in ast.walk(node.test):
+                if isinstance(name, ast.Name) \
+                        and isinstance(name.ctx, ast.Load) \
+                        and name.id in params:
+                    self.findings.append(Finding(
+                        "python-if-on-traced", self.path, node.lineno,
+                        self.symbol,
+                        f"Python `if` on parameter '{name.id}' of a "
+                        "traced function — branch with lax.cond/"
+                        "jnp.where, or hoist to a static argument"))
+                    break
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------- #
+# rule: weight-matrix-bypass
+# --------------------------------------------------------------------- #
+
+def _module_is_weight_authority(tree: ast.Module) -> bool:
+    """True when the module declares ``_WEIGHT_AUTHORITY = True`` at
+    top level — the opt-in marker for "this module is where weight
+    tables are legitimately constructed from scratch"."""
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id == "_WEIGHT_AUTHORITY":
+                    return isinstance(stmt.value, ast.Constant) \
+                        and stmt.value.value is True
+    return False
+
+
+class _WeightBypassVisitor(_ScopeTracker):
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self.path = path
+        self.findings: List[Finding] = []
+
+    def _check(self, targets: Iterable[ast.expr], value: ast.expr,
+               lineno: int) -> None:
+        names = [_last_attr(t) for t in targets]
+        if not any(n and WEIGHT_NAME_RE.search(n) for n in names):
+            return
+        raw = sanctioned = False
+        for node in ast.walk(value):
+            if isinstance(node, ast.Call):
+                tail = _last_attr(node.func)
+                if tail in WEIGHT_HELPERS:
+                    sanctioned = True
+                elif tail in _RAW_CONSTRUCTORS:
+                    raw = True
+        if raw and not sanctioned:
+            bound = next(n for n in names if n and WEIGHT_NAME_RE.search(n))
+            self.findings.append(Finding(
+                "weight-matrix-bypass", self.path, lineno, self.symbol,
+                f"'{bound}' built from a raw ndarray constructor; use "
+                "the shared row-stochastic helpers (topology.spec / "
+                "resilience.healing) or mark the module "
+                "_WEIGHT_AUTHORITY"))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check(node.targets, node.value, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check([node.target], node.value, node.lineno)
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------- #
+# rule: unseeded-randomness (benchmarks/)
+# --------------------------------------------------------------------- #
+
+class _UnseededRandomVisitor(_ScopeTracker):
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self.path = path
+        self.findings: List[Finding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) \
+                and f.attr not in _SEEDED_RANDOM_OK:
+            owner = _dotted(f.value)
+            if owner in ("np.random", "numpy.random", "onp.random"):
+                self.findings.append(Finding(
+                    "unseeded-randomness", self.path, node.lineno,
+                    self.symbol,
+                    f"np.random.{f.attr} draws from hidden global "
+                    "state; benchmarks must use an explicit "
+                    "default_rng(seed) / RandomState(seed)"))
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------- #
+# rule: unregistered-pytest-marker (tests/)
+# --------------------------------------------------------------------- #
+
+def registered_markers(root: str) -> Set[str]:
+    """Markers declared in ``[tool.pytest.ini_options] markers`` of the
+    repo's pyproject.toml, parsed textually (python 3.10: no tomllib;
+    the markers block is a simple list of ``"name: description"``
+    strings)."""
+    path = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(path):
+        return set()
+    text = open(path).read()
+    m = re.search(r"^markers\s*=\s*\[(.*?)\]", text,
+                  re.MULTILINE | re.DOTALL)
+    if not m:
+        return set()
+    body = m.group(1)
+    # TOML strings; try double-quoted first (apostrophes inside
+    # descriptions must not act as delimiters), else single-quoted
+    entries = re.findall(r'"([^"]*)"', body) \
+        or re.findall(r"'([^']*)'", body)
+    return {entry.split(":", 1)[0].strip() for entry in entries}
+
+
+class _MarkerVisitor(_ScopeTracker):
+    def __init__(self, path: str, known: Set[str]) -> None:
+        super().__init__()
+        self.path = path
+        self.known = known | BUILTIN_MARKERS
+        self.findings: List[Finding] = []
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # pytest.mark.<name>, possibly called: pytest.mark.foo(...)
+        v = node.value
+        if isinstance(v, ast.Attribute) and v.attr == "mark" \
+                and _dotted(v) == "pytest.mark" \
+                and node.attr not in self.known:
+            self.findings.append(Finding(
+                "unregistered-pytest-marker", self.path, node.lineno,
+                self.symbol,
+                f"marker '{node.attr}' is not declared in "
+                "pyproject.toml [tool.pytest.ini_options] markers"))
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------- #
+# drivers
+# --------------------------------------------------------------------- #
+
+def lint_file(path: str, rel: str, *, markers: Set[str],
+              in_package: bool, in_benchmarks: bool,
+              in_tests: bool) -> List[Finding]:
+    """All findings for one file.  ``rel`` is the repo-relative posix
+    path recorded on the findings; the ``in_*`` flags select which rule
+    families apply (set by :func:`run_lint` from the file's location)."""
+    try:
+        tree = ast.parse(open(path).read(), filename=path)
+    except SyntaxError as e:
+        return [Finding("syntax-error", rel, e.lineno or 0, "<module>",
+                        f"file does not parse: {e.msg}")]
+    findings: List[Finding] = []
+    if in_package:
+        if os.path.basename(path) != "config.py":
+            v = _EnvReadVisitor(rel)
+            v.visit(tree)
+            findings += v.findings
+        tv = _TracedBodyVisitor(rel, _collect_traced_names(tree),
+                                _collect_path_callbacks(tree))
+        tv.visit(tree)
+        findings += tv.findings
+        if not _module_is_weight_authority(tree):
+            wv = _WeightBypassVisitor(rel)
+            wv.visit(tree)
+            findings += wv.findings
+    if in_benchmarks:
+        rv = _UnseededRandomVisitor(rel)
+        rv.visit(tree)
+        findings += rv.findings
+    if in_tests:
+        mv = _MarkerVisitor(rel, markers)
+        mv.visit(tree)
+        findings += mv.findings
+    return findings
+
+
+def _py_files(base: str):
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def run_lint(root: str) -> List[Finding]:
+    """Lint the whole checkout at ``root``: ``bluefog_tpu/`` (package
+    rules), ``benchmarks/`` (randomness rule), ``tests/`` (marker
+    rule).  Missing directories are skipped, so the lint also works on
+    an installed package tree."""
+    markers = registered_markers(root)
+    findings: List[Finding] = []
+    scans = [("bluefog_tpu", dict(in_package=True, in_benchmarks=False,
+                                  in_tests=False)),
+             ("benchmarks", dict(in_package=False, in_benchmarks=True,
+                                 in_tests=False)),
+             ("tests", dict(in_package=False, in_benchmarks=False,
+                            in_tests=True))]
+    for sub, flags in scans:
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for path in _py_files(base):
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            findings += lint_file(path, rel, markers=markers, **flags)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -45,19 +45,17 @@ def _maybe_init_distributed() -> None:
     that ``bfrun`` sets (bluefog_tpu/run/run.py) — must happen before the
     first backend touch."""
     global _distributed_initialized
-    import os
 
-    coord = os.environ.get("BLUEFOG_TPU_COORDINATOR")
-    nproc = int(os.environ.get("BLUEFOG_TPU_NUM_PROCESSES", "1"))
+    coord = bfconfig.coordinator()
+    nproc = bfconfig.num_processes()
     if _distributed_initialized or not coord or nproc <= 1:
         return
-    pid_str = os.environ.get("BLUEFOG_TPU_PROCESS_ID")
-    if pid_str is None:
+    pid = bfconfig.process_id()
+    if pid is None:
         raise BluefogError(
             "BLUEFOG_TPU_COORDINATOR and BLUEFOG_TPU_NUM_PROCESSES are set "
             "but BLUEFOG_TPU_PROCESS_ID is missing; every process must "
             "export its id (bfrun sets all three).")
-    pid = int(pid_str)
     try:
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid)

@@ -42,11 +42,11 @@ _PEAK_BF16_TFLOPS = (
 def chip_peak_flops(device=None) -> float:
     """Peak dense bf16 FLOP/s of one chip, or 0.0 when unknown (CPU test
     meshes).  Override: BLUEFOG_CHIP_PEAK_TFLOPS=<float>."""
-    import os
+    from bluefog_tpu import config as bfconfig
 
-    override = os.environ.get("BLUEFOG_CHIP_PEAK_TFLOPS")
+    override = bfconfig.chip_peak_tflops_override()
     if override:
-        return float(override) * 1e12
+        return override * 1e12
     if device is None:
         device = jax.devices()[0]
     kind = getattr(device, "device_kind", "").lower()
@@ -73,11 +73,11 @@ _HBM_GBPS = (
 def chip_hbm_bandwidth(device=None) -> float:
     """HBM bandwidth of one chip in bytes/s, or 0.0 when unknown (CPU
     test meshes).  Override: BLUEFOG_CHIP_HBM_GBPS=<float>."""
-    import os
+    from bluefog_tpu import config as bfconfig
 
-    override = os.environ.get("BLUEFOG_CHIP_HBM_GBPS")
+    override = bfconfig.chip_hbm_gbps_override()
     if override:
-        return float(override) * 1e9
+        return override * 1e9
     if device is None:
         device = jax.devices()[0]
     kind = getattr(device, "device_kind", "").lower()
@@ -444,6 +444,111 @@ def scheduled_collective_windows(hlo_text: str) -> list:
                 "independent_bytes_accessed": float(ibytes),
             })
     return out
+
+
+def _count_hlo_collectives(hlo_text: str, kind: str) -> int:
+    """Instruction count of one collective ``kind`` in optimized HLO —
+    sync spelling plus async ``-start`` (the start counted alone so an
+    async pair is one op), the counting rule the HLO-guarantee tests
+    always used."""
+    return len(re.findall(re.escape(kind) + r"(?:-start)?\(", hlo_text))
+
+
+def _expected_replica_groups(n_groups: int, group_size: int) -> str:
+    """The ``replica_groups`` attribute text of a grouped all-reduce over
+    contiguous rank blocks — machine g owns ranks
+    ``[g*L, (g+1)*L)``, exactly how the hierarchical exchange groups."""
+    groups = ",".join(
+        "{" + ",".join(str(g * group_size + i) for i in range(group_size))
+        + "}" for g in range(n_groups))
+    return "replica_groups={" + groups + "}"
+
+
+def verify_collective_contract(compiled, predicted, payload_bytes,
+                               *, round_index=None) -> list:
+    """Hold a lowered program to its declared collective sketch.
+
+    ``compiled`` is optimized HLO text or anything with ``.as_text()``
+    (a jit ``Compiled``); ``predicted`` is a
+    ``CompiledTopology.predicted_collectives(payload_bytes)`` /
+    ``CompiledHierarchicalTopology`` dict.  With ``round_index=None``
+    the module is the full (e.g. ``lax.switch``) program and is checked
+    against the per-period totals; with ``round_index=i`` it is round
+    *i* lowered alone and is checked against ``per_round[i]``.
+
+    Returns a list of human-readable mismatch strings — empty means the
+    contract holds.  This is the supported promotion of the
+    predicted-vs-lowered comparison the HLO-guarantee tests pioneered
+    (tests/test_hlo_guarantees.py is now a thin wrapper, and
+    ``bluefog_tpu.analysis`` runs the same check statically): permute
+    count, per-permute payload bytes, total bytes, and — for
+    hierarchical predictions — the grouped all-reduce count and its
+    ``replica_groups`` machine decomposition.
+    """
+    hlo = compiled.as_text() if hasattr(compiled, "as_text") else compiled
+    problems = []
+
+    per_round = predicted.get("per_round", [])
+    # internal consistency of the prediction itself: the per-period
+    # totals must be the per-round sum, or the dict was tampered/stale
+    if per_round:
+        tot_p = sum(r["permutes"] for r in per_round)
+        if tot_p != predicted["permutes_per_period"]:
+            problems.append(
+                f"prediction inconsistent: per_round permutes sum {tot_p}"
+                f" != permutes_per_period "
+                f"{predicted['permutes_per_period']}")
+        tot_b = float(sum(r["permutes"] * r["bytes_per_permute"]
+                          for r in per_round))
+        if tot_b != predicted["bytes_per_period"]:
+            problems.append(
+                f"prediction inconsistent: per_round bytes sum {tot_b}"
+                f" != bytes_per_period {predicted['bytes_per_period']}")
+
+    wins = [w for w in scheduled_collective_windows(hlo)
+            if w["kind"] == "collective-permute"]
+    if round_index is None:
+        want_p = predicted["permutes_per_period"]
+        want_bytes = predicted["bytes_per_period"]
+        want_r = predicted.get("all_reduces_per_period")
+    else:
+        rp = per_round[round_index]
+        want_p = rp["permutes"]
+        want_bytes = rp["permutes"] * rp["bytes_per_permute"]
+        want_r = rp.get("all_reduces")
+        payload_bytes = rp.get("bytes_per_permute", payload_bytes)
+
+    where = ("program" if round_index is None
+             else f"round {round_index}")
+    if len(wins) != want_p:
+        problems.append(
+            f"{where}: {len(wins)} collective-permutes lowered, "
+            f"predicted {want_p}")
+    bad = [w["bytes"] for w in wins if w["bytes"] != payload_bytes]
+    if bad:
+        problems.append(
+            f"{where}: permute payloads {bad} != predicted "
+            f"{payload_bytes} bytes")
+    got_bytes = sum(w["bytes"] for w in wins)
+    if got_bytes != want_bytes:
+        problems.append(
+            f"{where}: {got_bytes} permute bytes lowered, predicted "
+            f"{want_bytes}")
+    if want_r is not None:
+        got_r = _count_hlo_collectives(hlo, "all-reduce")
+        if got_r != want_r:
+            problems.append(
+                f"{where}: {got_r} all-reduces lowered, predicted "
+                f"{want_r}")
+        groups = predicted.get("all_reduce_groups")
+        size = predicted.get("all_reduce_group_size")
+        if got_r and groups and size and size > 1:
+            expect = _expected_replica_groups(groups, size)
+            if expect not in hlo:
+                problems.append(
+                    f"{where}: grouped all-reduce missing machine "
+                    f"decomposition {expect}")
+    return problems
 
 
 def hlo_op_breakdown(hlo_text: str) -> dict:

@@ -15,8 +15,11 @@ __all__ = [
     "log_hide_time",
     "log_format",
     "observe",
+    "observe_raw",
     "timeline_path",
     "timeline_flush_every",
+    "timeline_queue_capacity",
+    "timeline_native",
     "straggler_z_threshold",
     "skip_negotiate_default",
     "ops_on_cpu",
@@ -29,6 +32,15 @@ __all__ = [
     "prefix_cache_mb",
     "elastic_bootstrap_rounds",
     "elastic_quarantine_threshold",
+    "coordinator",
+    "num_processes",
+    "process_id",
+    "engine_token",
+    "state_dir",
+    "chip_peak_tflops_override",
+    "chip_hbm_gbps_override",
+    "environ_passthrough",
+    "configure_host_platform",
 ]
 
 
@@ -58,9 +70,15 @@ def observe() -> bool:
     """BLUEFOG_OBSERVE (default on): whether the built-in publishers
     write into the observability registry/tracer
     (:mod:`bluefog_tpu.observe`).  ``0`` opts out."""
-    from bluefog_tpu.observe.registry import enabled
+    return observe_raw()
 
-    return enabled()
+
+def observe_raw() -> bool:
+    """The raw BLUEFOG_OBSERVE read.
+    :func:`bluefog_tpu.observe.registry.enabled` is the public gate the
+    publishers call; it delegates here so the env access itself lives in
+    this module (the ``env-read-outside-config`` lint contract)."""
+    return _env("BLUEFOG_OBSERVE", "1") not in ("0", "false", "False")
 
 
 def timeline_path() -> str:
@@ -79,6 +97,25 @@ def timeline_flush_every() -> int:
         return max(1, int(_env("BLUEFOG_TIMELINE_FLUSH_EVERY", "1024")))
     except ValueError:
         return 1024
+
+
+def timeline_queue_capacity() -> int:
+    """BLUEFOG_TIMELINE_QUEUE_CAPACITY (default 65536): bound of the
+    Python timeline writer's event queue — roughly the native ring's
+    depth.  A full queue drops the event and counts it (the bounded
+    contract both backends share); override for stress tests."""
+    try:
+        return max(1, int(_env("BLUEFOG_TIMELINE_QUEUE_CAPACITY",
+                               "65536")))
+    except ValueError:
+        return 65536
+
+
+def timeline_native() -> bool:
+    """BLUEFOG_TIMELINE_NATIVE (default on): prefer the C++ lock-free
+    ring writer when the native extension built; ``0`` forces the
+    Python queue backend."""
+    return _env("BLUEFOG_TIMELINE_NATIVE", "1") != "0"
 
 
 def straggler_z_threshold() -> float:
@@ -220,3 +257,90 @@ def ops_on_cpu() -> bool:
     """BLUEFOG_OPS_ON_CPU — run collectives on the host CPU backend instead
     of the accelerator (reference torch/mpi_ops.cc:48-50)."""
     return _env("BLUEFOG_OPS_ON_CPU", "0") in ("1", "true", "True")
+
+
+# ------------------------------------------------------------------ #
+# launcher / process-identity contract (the BLUEFOG_TPU_* vars bfrun
+# exports into every child — bluefog_tpu/run/run.py _child_env)
+# ------------------------------------------------------------------ #
+def coordinator() -> str:
+    """BLUEFOG_TPU_COORDINATOR: ``host:port`` of the jax.distributed
+    coordinator; empty when not launched by bfrun (single process)."""
+    return _env("BLUEFOG_TPU_COORDINATOR", "")
+
+
+def num_processes() -> int:
+    """BLUEFOG_TPU_NUM_PROCESSES (default 1): job size bfrun exported."""
+    try:
+        return int(_env("BLUEFOG_TPU_NUM_PROCESSES", "1"))
+    except ValueError:
+        return 1
+
+
+def process_id():
+    """BLUEFOG_TPU_PROCESS_ID as an int, or ``None`` when unset (or
+    unparsable) — callers that REQUIRE an id under a coordinator
+    (api._maybe_init_distributed) treat None as the error it is; the
+    log formatter falls back to rank 0."""
+    raw = _env("BLUEFOG_TPU_PROCESS_ID", "")
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def engine_token() -> str:
+    """BLUEFOG_TPU_ENGINE_TOKEN: shared secret the interactive-run
+    engine processes require on every control connection
+    (bluefog_tpu/run/engines.py); empty disables nothing — an empty
+    token still HMACs, it is just guessable."""
+    return _env("BLUEFOG_TPU_ENGINE_TOKEN", "")
+
+
+def state_dir() -> str:
+    """BLUEFOG_TPU_STATE_DIR (default ``~/.bluefog_tpu``), expanded:
+    where ``ibfrun`` keeps its per-profile engine state files."""
+    return os.path.expanduser(_env("BLUEFOG_TPU_STATE_DIR",
+                                   "~/.bluefog_tpu"))
+
+
+def chip_peak_tflops_override():
+    """BLUEFOG_CHIP_PEAK_TFLOPS: per-chip peak bf16 TFLOP/s override for
+    :func:`bluefog_tpu.benchutil.chip_peak_flops` (auditing a TPU target
+    from a CPU host).  ``None``/0 when unset or empty."""
+    raw = _env("BLUEFOG_CHIP_PEAK_TFLOPS", "")
+    return float(raw) if raw else None
+
+
+def chip_hbm_gbps_override():
+    """BLUEFOG_CHIP_HBM_GBPS: per-chip HBM GB/s override for
+    :func:`bluefog_tpu.benchutil.chip_hbm_bandwidth`; same convention as
+    :func:`chip_peak_tflops_override`."""
+    raw = _env("BLUEFOG_CHIP_HBM_GBPS", "")
+    return float(raw) if raw else None
+
+
+def environ_passthrough(base=None) -> dict:
+    """Snapshot of the process environment (or ``base`` when given) for
+    the launchers' pass-through forwarding — bfrun/ibfrun filter this
+    by ``PASS_PREFIXES`` when building child/remote environments.  The
+    one sanctioned whole-environment read outside this module's named
+    accessors, kept here so the env-access surface stays auditable."""
+    return dict(os.environ if base is None else base)
+
+
+def configure_host_platform(devices: int = 8) -> None:
+    """Force the JAX CPU backend with ``devices`` virtual devices —
+    the same environment tests/conftest.py pins — by setting
+    ``JAX_PLATFORMS=cpu`` and merging
+    ``--xla_force_host_platform_device_count`` into ``XLA_FLAGS``.
+    Must run BEFORE the first jax import; used by ``bfcheck`` so the
+    static sweep can build 8-rank programs anywhere.  Values already
+    present in the environment win."""
+    env = os.environ
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={devices}"
+        ).strip()

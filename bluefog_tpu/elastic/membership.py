@@ -37,6 +37,11 @@ instantly re-excised by a latched suspicion.
 
 from __future__ import annotations
 
+# This module legitimately constructs weight tables from scratch — the
+# analysis lint's weight-matrix-bypass rule treats it as an authority
+# (everywhere else, tables must come from the shared helpers here).
+_WEIGHT_AUTHORITY = True
+
 import dataclasses
 from collections import OrderedDict
 from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple,

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import sys
 
 from bluefog_tpu import config as bfconfig
@@ -46,7 +45,7 @@ class _JsonFormatter(logging.Formatter):
             "ts": round(record.created, 6),
             "level": record.levelname,
             "logger": record.name,
-            "rank": int(os.environ.get("BLUEFOG_TPU_PROCESS_ID", "0")),
+            "rank": bfconfig.process_id() or 0,
             "msg": record.getMessage(),
         }
         try:

@@ -33,7 +33,8 @@ from bluefog_tpu.observe.registry import (Counter, Gauge, Histogram,
                                           get_registry, percentile)
 from bluefog_tpu.observe.tracer import Tracer, get_tracer, publish_tracer
 from bluefog_tpu.observe.stepprof import (StepProfile, hlo_op_breakdown,
-                                          profile_step)
+                                          profile_step,
+                                          verify_collective_contract)
 from bluefog_tpu.observe.export import (chrome_trace, jsonl_events,
                                         prometheus_text, snapshot)
 from bluefog_tpu.observe.fleet import (FleetAggregate, FleetAggregator,
@@ -46,6 +47,7 @@ __all__ = [
     "get_registry", "percentile",
     "Tracer", "get_tracer", "publish_tracer",
     "StepProfile", "profile_step", "hlo_op_breakdown",
+    "verify_collective_contract",
     "prometheus_text", "jsonl_events", "chrome_trace", "snapshot",
     "FleetAggregate", "FleetAggregator", "StragglerDetector",
     "collect_local", "edge_list", "push_sum_matrix",

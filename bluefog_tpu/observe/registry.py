@@ -25,7 +25,6 @@ publisher skip the registry (and the tracer); see :func:`enabled`.
 
 from __future__ import annotations
 
-import os
 import threading
 from collections import deque
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -52,9 +51,12 @@ def enabled() -> bool:
     runner, eager ops, train-step wrappers, timeline) write into the
     registry/tracer.  ``BLUEFOG_OBSERVE=0`` opts out; read dynamically
     so tests can flip it per-case.  Note this gates *publication* only:
-    a registry you hold and update yourself always works."""
-    return os.environ.get("BLUEFOG_OBSERVE", "1") not in ("0", "false",
-                                                          "False")
+    a registry you hold and update yourself always works.  (The env
+    access itself lives in :func:`bluefog_tpu.config.observe_raw`;
+    imported lazily — config comes up before the observe layer.)"""
+    from bluefog_tpu import config as bfconfig
+
+    return bfconfig.observe_raw()
 
 
 LabelKey = Tuple[Tuple[str, str], ...]

@@ -40,12 +40,19 @@ from bluefog_tpu import benchutil
 from bluefog_tpu.observe.registry import enabled, get_registry
 
 __all__ = ["StepProfile", "profile_step", "hlo_op_breakdown",
-           "profile_cache_info", "profile_cache_clear"]
+           "verify_collective_contract", "profile_cache_info",
+           "profile_cache_clear"]
 
 # the per-op view lives with the rest of the HLO machinery in benchutil
 # (public there); re-exported here because StepProfile.op_breakdown is
 # its supported entry point
 hlo_op_breakdown = benchutil.hlo_op_breakdown
+
+# the predicted-vs-lowered collective check rides the same HLO
+# machinery; re-exported because a step profile and a contract check
+# are the two supported consumers of one compiled artifact
+# (bluefog_tpu.analysis and tests/test_hlo_guarantees.py both call it)
+verify_collective_contract = benchutil.verify_collective_contract
 
 
 @dataclasses.dataclass

@@ -50,6 +50,11 @@ fold 1-ulp caveat of the pre-fusion path (CHANGES.md PR 3) is gone.
 
 from __future__ import annotations
 
+# This module legitimately constructs weight tables from scratch — the
+# analysis lint's weight-matrix-bypass rule treats it as an authority
+# (everywhere else, tables must come from the shared helpers here).
+_WEIGHT_AUTHORITY = True
+
 import dataclasses
 from functools import partial
 from typing import Any, Callable, NamedTuple, Optional, Sequence, Union
@@ -1110,9 +1115,14 @@ def _build_fused_train_step(
         compress=compress, guard=guarded, health=want_health,
         consensus=want_cons)
 
-    def _decorate(step_fn, lower):
+    def _decorate(step_fn, adapt):
+        # ``adapt`` maps the step's PUBLIC signature to the jitted
+        # program's full argument tuple; .lower and .trace share it so
+        # AOT compilation (benchmarks) and jaxpr inspection
+        # (bluefog_tpu.analysis) see the identical program.
         step_fn.jitted = jitted
-        step_fn.lower = lower
+        step_fn.lower = lambda *args: jitted.lower(*adapt(*args))
+        step_fn.trace = lambda *args: jitted.trace(*adapt(*args))
         step_fn.health_config = health
         step_fn.epilogue_stages = stages
         step_fn.has_aux = has_aux
@@ -1134,8 +1144,8 @@ def _build_fused_train_step(
             return _decorate(
                 _observed_step(aux_step, obs_labels, edge_traffic),
                 lambda params, aux, opt_state, batch, step,
-                comm_weights: jitted.lower(params, aux, opt_state,
-                                           batch, step, comm_weights))
+                comm_weights: (params, aux, opt_state, batch, step,
+                               comm_weights))
 
         if health is None:
             def no_aux_step(params, opt_state, batch, step,
@@ -1153,8 +1163,7 @@ def _build_fused_train_step(
         return _decorate(
             _observed_step(no_aux_step, obs_labels, edge_traffic),
             lambda params, opt_state, batch, step, comm_weights:
-            jitted.lower(params, (), opt_state, batch, step,
-                         comm_weights))
+            (params, (), opt_state, batch, step, comm_weights))
 
     if has_aux:
         def aux_step(params, aux, opt_state, batch, step):
@@ -1164,8 +1173,7 @@ def _build_fused_train_step(
         return _decorate(
             _observed_step(aux_step, obs_labels, edge_traffic),
             lambda params, aux, opt_state, batch, step:
-            jitted.lower(params, aux, opt_state, batch, step,
-                         default_w))
+            (params, aux, opt_state, batch, step, default_w))
 
     if health is None:
         def no_aux_step(params, opt_state, batch, step):
@@ -1181,7 +1189,7 @@ def _build_fused_train_step(
     return _decorate(
         _observed_step(no_aux_step, obs_labels, edge_traffic),
         lambda params, opt_state, batch, step:
-        jitted.lower(params, (), opt_state, batch, step, default_w))
+        (params, (), opt_state, batch, step, default_w))
 
 
 def build_train_step(
@@ -1638,6 +1646,7 @@ def build_train_step(
         aux_step = _observed_step(jitted, obs_labels, edge_traffic)
         aux_step.jitted = jitted
         aux_step.lower = jitted.lower
+        aux_step.trace = jitted.trace
         aux_step.health_config = health
         aux_step.hierarchical_local_size = \
             hierarchical_local_size if comm_mode in ("cta", "atc") else None
@@ -1656,9 +1665,12 @@ def build_train_step(
 
     step_fn = _observed_step(no_aux_step, obs_labels, edge_traffic)
     # AOT access for benchmarks: lower/compile the real program (e.g. for
-    # XLA cost analysis / MFU accounting) without re-jitting the wrapper.
+    # XLA cost analysis / MFU accounting) without re-jitting the wrapper;
+    # .trace is the jaxpr-inspection analog bluefog_tpu.analysis uses.
     step_fn.jitted = jitted
     step_fn.lower = lambda params, opt_state, batch, step: jitted.lower(
+        params, (), opt_state, batch, step)
+    step_fn.trace = lambda params, opt_state, batch, step: jitted.trace(
         params, (), opt_state, batch, step)
     step_fn.health_config = health
     step_fn.hierarchical_local_size = \
@@ -1825,6 +1837,8 @@ def _build_guarded_train_step(
 
         step_fn = _observed_step(aux_step, obs_labels, edge_traffic)
         step_fn.jitted = jitted
+        step_fn.lower = jitted.lower
+        step_fn.trace = jitted.trace
         step_fn.default_comm_weights = default_w
         step_fn.has_aux = True  # run_resilient rejects aux signatures
         step_fn.guard_config = guard
@@ -1849,6 +1863,9 @@ def _build_guarded_train_step(
     step_fn.lower = (
         lambda params, opt_state, batch, step, comm_weights:
         jitted.lower(params, (), opt_state, batch, step, comm_weights))
+    step_fn.trace = (
+        lambda params, opt_state, batch, step, comm_weights:
+        jitted.trace(params, (), opt_state, batch, step, comm_weights))
     step_fn.default_comm_weights = default_w
     step_fn.has_aux = False
     step_fn.guard_config = guard

@@ -27,6 +27,11 @@ Design notes
 
 from __future__ import annotations
 
+# This module legitimately constructs weight tables from scratch — the
+# analysis lint's weight-matrix-bypass rule treats it as an authority
+# (everywhere else, tables must come from the shared helpers here).
+_WEIGHT_AUTHORITY = True
+
 from functools import partial
 from typing import Optional, Sequence, Union
 

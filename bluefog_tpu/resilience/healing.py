@@ -33,6 +33,11 @@ guard keeps every rank's params finite — 0 * finite == 0.
 
 from __future__ import annotations
 
+# This module legitimately constructs weight tables from scratch — the
+# analysis lint's weight-matrix-bypass rule treats it as an authority
+# (everywhere else, tables must come from the shared helpers here).
+_WEIGHT_AUTHORITY = True
+
 from typing import List, Sequence, Union
 
 import numpy as np

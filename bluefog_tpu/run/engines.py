@@ -42,6 +42,8 @@ import sys
 import traceback
 from typing import Any, List, Optional
 
+from bluefog_tpu import config as bfconfig
+
 __all__ = ["Client", "engine_main"]
 
 _LEN = struct.Struct(">Q")
@@ -81,7 +83,7 @@ def engine_main(port_file: str) -> None:
     (announced atomically through ``port_file``), then serve exec/eval
     requests against one persistent namespace until shutdown.  Every
     connection must authenticate first (``BLUEFOG_TPU_ENGINE_TOKEN``)."""
-    token = os.environ.get("BLUEFOG_TPU_ENGINE_TOKEN", "")
+    token = bfconfig.engine_token()
     ns: dict = {"__name__": "__bluefog_engine__"}
     srv = socket.socket()
     srv.bind(("127.0.0.1", 0))

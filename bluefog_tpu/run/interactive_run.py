@@ -41,12 +41,12 @@ import sys
 import time
 from typing import Dict, List, Optional
 
+from bluefog_tpu import config as bfconfig
 from bluefog_tpu.run.run import PASS_PREFIXES
 
 
 def _state_path(profile: str) -> str:
-    d = os.path.expanduser(os.environ.get("BLUEFOG_TPU_STATE_DIR",
-                                          "~/.bluefog_tpu"))
+    d = bfconfig.state_dir()
     os.makedirs(d, exist_ok=True)
     return os.path.join(d, f"ibfrun_{profile}.json")
 
@@ -58,7 +58,7 @@ def engine_env(process_id: int, num_proc: int, coordinator: str,
     ipengine a member of the bluefog_tpu job (the reference gets this from
     mpirun's rank assignment; here bfrun's env contract is reused,
     bluefog_tpu/run/run.py _child_env)."""
-    env = {k: v for k, v in (base_env or os.environ).items()
+    env = {k: v for k, v in bfconfig.environ_passthrough(base_env).items()
            if k.startswith(PASS_PREFIXES)}
     env["BLUEFOG_TPU_COORDINATOR"] = coordinator
     env["BLUEFOG_TPU_NUM_PROCESSES"] = str(num_proc)

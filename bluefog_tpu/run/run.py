@@ -44,6 +44,8 @@ import sys
 import threading
 import time
 
+from bluefog_tpu import config as bfconfig
+
 PASS_PREFIXES = ("BLUEFOG_", "JAX_", "XLA_", "TPU_", "PYTHON", "PATH",
                  "HOME", "LD_", "TMPDIR", "VIRTUAL_ENV")
 
@@ -159,7 +161,7 @@ def _coordinator_for_attempt(coordinator: str, attempt: int) -> str:
 
 def _child_env(args, process_id: int, attempt: int,
                coordinator: str) -> dict:
-    env = {k: v for k, v in os.environ.items()
+    env = {k: v for k, v in bfconfig.environ_passthrough().items()
            if k.startswith(PASS_PREFIXES)}
     # the caller resolves the coordinator ONCE per attempt (per-child
     # probing could hand ranks different addresses once rank 0's
@@ -351,7 +353,8 @@ def _host_launcher_argv(args, host: str, host_rank: int, offset: int,
     for kv in args.extra_env:
         inner += ["--extra-env", kv]
     inner += ["--"] + list(command)
-    env_pairs = [f"{k}={v}" for k, v in sorted(os.environ.items())
+    env_pairs = [f"{k}={v}"
+                 for k, v in sorted(bfconfig.environ_passthrough().items())
                  if k.startswith(PASS_PREFIXES)]
     shell = ("cd " + shlex.quote(os.getcwd()) + " && exec env "
              + " ".join(shlex.quote(p) for p in env_pairs) + " "

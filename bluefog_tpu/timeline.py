@@ -39,7 +39,6 @@ from __future__ import annotations
 
 import atexit
 import json
-import os
 import queue
 import threading
 import time
@@ -53,10 +52,10 @@ from bluefog_tpu.observe import tracer as _obs_tracer
 __all__ = ["Timeline", "get_timeline", "start_timeline", "stop_timeline"]
 
 # Python-backend queue bound: ~the native ring's depth.  Override with
-# BLUEFOG_TIMELINE_QUEUE_CAPACITY for stress tests.  (The drop-count
-# flush interval lives in config.timeline_flush_every:
-# BLUEFOG_TIMELINE_FLUSH_EVERY, default 1024.)
-_DEFAULT_QUEUE_CAPACITY = 65536
+# BLUEFOG_TIMELINE_QUEUE_CAPACITY (config.timeline_queue_capacity) for
+# stress tests.  (The drop-count flush interval lives in
+# config.timeline_flush_every: BLUEFOG_TIMELINE_FLUSH_EVERY,
+# default 1024.)
 
 
 class _PyWriter:
@@ -75,9 +74,7 @@ class _PyWriter:
         self.rank = rank
         self._t0 = time.perf_counter()
         if capacity is None:
-            capacity = int(os.environ.get(
-                "BLUEFOG_TIMELINE_QUEUE_CAPACITY",
-                str(_DEFAULT_QUEUE_CAPACITY)))
+            capacity = bfconfig.timeline_queue_capacity()
         self._queue: "queue.Queue" = queue.Queue(maxsize=capacity)
         self._dropped = 0
         self._on_drop_flush = on_drop_flush
@@ -161,7 +158,7 @@ class _PyWriter:
 def _make_writer(path: str, rank: int, use_native: Optional[bool],
                  on_drop_flush=None):
     if use_native is None:
-        use_native = os.environ.get("BLUEFOG_TIMELINE_NATIVE", "1") != "0"
+        use_native = bfconfig.timeline_native()
     if use_native:
         try:
             from bluefog_tpu import native

@@ -14,6 +14,11 @@ This module is pure NumPy (host-side, trace-time) — nothing here touches jax.
 
 from __future__ import annotations
 
+# This module legitimately constructs weight tables from scratch — the
+# analysis lint's weight-matrix-bypass rule treats it as an authority
+# (everywhere else, tables must come from the shared helpers here).
+_WEIGHT_AUTHORITY = True
+
 import dataclasses
 import functools
 import hashlib

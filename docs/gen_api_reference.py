@@ -105,6 +105,12 @@ MODULES = [
     ("bluefog_tpu.run.run", "bfrun launcher (local + multi-host)"),
     ("bluefog_tpu.utility", "broadcast/allreduce convenience helpers"),
     ("bluefog_tpu.config", "environment-variable configuration"),
+    ("bluefog_tpu.analysis",
+     "static contract checker (bfcheck): findings + baseline"),
+    ("bluefog_tpu.analysis.lint",
+     "AST lint: env reads, host syncs, traced-if, weight bypass"),
+    ("bluefog_tpu.analysis.jaxpr_check",
+     "jaxpr/HLO sweep: weights-as-data, divergent cond, collectives"),
 ]
 
 

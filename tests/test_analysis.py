@@ -1,0 +1,385 @@
+"""bluefog_tpu.analysis: the static contract checker's own contract.
+
+Three layers:
+
+* **The repo is clean** — the full ``bfcheck`` sweep (AST lint + jaxpr
+  matrix + collective contracts + serving residents) reports zero
+  unsuppressed findings on the checkout.  This is the tier-1 wiring:
+  every future PR runs the analyzer by running the tests.
+* **The checker has teeth (mutation tests)** — a step whose combine
+  bakes the weight tables as constants, a program that drops its
+  traced weight operand, a ``lax.cond`` over a per-rank-divergent
+  predicate, and a tampered collective prediction must each be
+  flagged.  Without these, a silently-neutered checker would keep
+  passing forever.
+* **Each lint rule fires and doesn't over-fire** — positive + negative
+  fixtures per rule, plus the baseline round-trip (a finding written
+  to a baseline is suppressed on the next run; unrelated findings are
+  not).
+"""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from bluefog_tpu import benchutil
+from bluefog_tpu.analysis import (Finding, load_baseline,
+                                  split_suppressed)
+from bluefog_tpu.analysis import jaxpr_check as J
+from bluefog_tpu.analysis import lint as L
+
+pytestmark = pytest.mark.analysis
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ #
+# the repo is clean (tier-1 full sweep)
+# ------------------------------------------------------------------ #
+
+def test_lint_repo_clean_modulo_baseline():
+    active, suppressed = split_suppressed(run_lint_cached(),
+                                          load_baseline())
+    assert active == [], "\n".join(f.render() for f in active)
+    # the baseline is not vacuous: it suppresses the documented
+    # benchutil XLA_FLAGS mutation (and nothing rots silently — every
+    # baseline key must still match a real finding)
+    assert suppressed, "baseline.txt suppresses nothing — stale?"
+    live = {f.key() for f in suppressed}
+    for key in load_baseline():
+        assert key in live, f"stale baseline entry: {key}"
+
+
+_lint_cache = []
+
+
+def run_lint_cached():
+    if not _lint_cache:
+        _lint_cache.append(L.run_lint(_REPO))
+    return _lint_cache[0]
+
+
+@pytest.mark.perf
+def test_jaxpr_sweep_full_matrix_clean():
+    """Every build_train_step variant of the epilogue parity matrix,
+    the compiled-topology collective contracts, and the serving
+    resident programs: zero findings."""
+    findings = J.run_sweep()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exits_zero_on_clean_repo(monkeypatch):
+    from bluefog_tpu.analysis.__main__ import main
+
+    monkeypatch.chdir(_REPO)
+    # lint-only through the real CLI (the jaxpr sweep already ran
+    # above; rerunning it here would double tier-1 wall time)
+    assert main(["--no-jaxpr"]) == 0
+
+
+def test_cli_exit_nonzero_on_finding(tmp_path, monkeypatch):
+    from bluefog_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "bluefog_tpu"
+    bad.mkdir()
+    (bad / "rogue.py").write_text("import os\nX = os.getenv('A')\n")
+    (tmp_path / "pyproject.toml").write_text("[project]\n")
+    monkeypatch.chdir(tmp_path)
+    assert main(["--no-jaxpr"]) == 1
+    # the same finding baselined -> exit 0
+    base = tmp_path / "baseline.txt"
+    base.write_text("env-read-outside-config bluefog_tpu/rogue.py"
+                    "::<module>  # vetted\n")
+    assert main(["--no-jaxpr", "--baseline", str(base)]) == 0
+
+
+# ------------------------------------------------------------------ #
+# mutation tests: the checker's teeth
+# ------------------------------------------------------------------ #
+
+@pytest.fixture
+def mesh():
+    return J._mesh()
+
+
+def test_mutant_baked_weight_constants_flagged():
+    """A step whose combine closes over concrete weight tables (while
+    accepting — and ignoring — the traced operand) must produce BOTH
+    findings: the baked constants and the dead operand."""
+    from bluefog_tpu.optim import functional as F
+
+    w = F.comm_weight_inputs([J._weighted_ring()])
+    leaves = jax.tree.leaves(w)
+    baked = [np.asarray(l) for l in leaves]
+
+    def mutant(x, comm_weights):
+        cls, self_w = jnp.asarray(baked[0]), jnp.asarray(baked[1])
+        return x * self_w.sum() + cls.sum()
+
+    traced = jax.jit(mutant).trace(jnp.zeros((8, 4)), w)
+    fs = J.check_traced(traced, name="mutant[baked]",
+                        weight_leaves=leaves)
+    rules = {f.rule for f in fs}
+    assert "baked-weight-const" in rules, fs
+    assert "dead-weight-operand" in rules, fs
+
+
+def test_healthy_step_not_flagged(mesh):
+    """Control for the mutation pair: the real guarded build on the
+    same ring is clean."""
+    case = dict(comm_mode="cta", overlap="none", guard=True,
+                health=True, compress=None, topology=J._weighted_ring())
+    assert J._build_and_check(case, mesh) == []
+
+
+def test_mutant_divergent_cond_flagged(mesh):
+    """lax.cond on an axis_index-derived predicate inside shard_map:
+    the PR-3 rule violation the taint walk exists to catch."""
+
+    def div(x):
+        r = jax.lax.axis_index("bf")
+        return jax.lax.cond(r > 2, lambda v: v + 1.0,
+                            lambda v: v - 1.0, x)
+
+    sm = jax.shard_map(div, mesh=mesh, in_specs=P("bf"),
+                       out_specs=P("bf"), check_vma=False)
+    traced = jax.jit(sm).trace(jnp.zeros((8, 4)))
+    fs = J.check_traced(traced, name="mutant[div]", taint_seed=[True])
+    assert any(f.rule == "divergent-cond" for f in fs), fs
+
+
+def test_consensus_cond_not_flagged(mesh):
+    """Control: a cond whose predicate went through psum (the guard's
+    consensus reduce) is replicated — no finding."""
+
+    def ok(x):
+        flag = jax.lax.psum(jnp.sum(x), "bf") > 0
+        return jax.lax.cond(flag, lambda v: v + 1.0,
+                            lambda v: v - 1.0, x)
+
+    sm = jax.shard_map(ok, mesh=mesh, in_specs=P("bf"),
+                       out_specs=P("bf"), check_vma=False)
+    traced = jax.jit(sm).trace(jnp.zeros((8, 4)))
+    assert J.check_traced(traced, name="ok[cond]",
+                          taint_seed=[True]) == []
+
+
+def test_mutant_dropped_permute_prediction_flagged(mesh):
+    """verify_collective_contract must reject a schedule prediction
+    with one permute shaved off (and, per round, a payload that
+    doesn't match the wire)."""
+    from bluefog_tpu.parallel import collectives as C
+    from bluefog_tpu.topology.compiler import PodSpec, compile_topology
+
+    compiled = compile_topology(PodSpec(1, 8))
+    payload = 64 * 4
+    pred = compiled.predicted_collectives(payload)
+
+    def combine(v, step):
+        brs = [(lambda s: lambda y: C.neighbor_allreduce(y, s, "bf"))(s)
+               for s in compiled.schedule]
+        return jax.lax.switch(step % len(brs), brs, v)
+
+    sm = jax.shard_map(combine, mesh=mesh, in_specs=(P("bf"), P()),
+                       out_specs=P("bf"), check_vma=False)
+    hlo = jax.jit(sm).lower(jnp.zeros((8, 64), jnp.float32),
+                            jnp.asarray(0)).compile().as_text()
+    assert benchutil.verify_collective_contract(hlo, pred, payload) == []
+
+    dropped = dict(pred)
+    dropped["permutes_per_period"] = pred["permutes_per_period"] - 1
+    dropped["per_round"] = [dict(r) for r in pred["per_round"]]
+    dropped["per_round"][0]["permutes"] -= 1
+    assert benchutil.verify_collective_contract(hlo, dropped, payload)
+
+    wrong_bytes = dict(pred)
+    assert benchutil.verify_collective_contract(
+        hlo, wrong_bytes, payload * 2)
+
+
+# ------------------------------------------------------------------ #
+# lint rules: positive + negative fixtures
+# ------------------------------------------------------------------ #
+
+_PKG = dict(in_package=True, in_benchmarks=False, in_tests=False)
+_BM = dict(in_package=False, in_benchmarks=True, in_tests=False)
+_TST = dict(in_package=False, in_benchmarks=False, in_tests=True)
+
+
+def _lint_src(tmp_path, src, *, markers=frozenset(), **flags):
+    p = tmp_path / "fixture.py"
+    p.write_text(textwrap.dedent(src))
+    return L.lint_file(str(p), "fixture.py", markers=set(markers),
+                       **flags)
+
+
+def test_rule_env_read(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import os
+        A = os.environ.get('X')
+        def f():
+            return os.getenv('Y')
+        """, **_PKG)
+    assert [f.rule for f in fs] == ["env-read-outside-config"] * 2
+    assert fs[1].symbol == "f"
+    assert _lint_src(tmp_path, """
+        from bluefog_tpu import config as bfconfig
+        A = bfconfig.coordinator()
+        """, **_PKG) == []
+
+
+def test_rule_host_sync(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import jax, numpy as np
+        @jax.jit
+        def f(x):
+            return float(x)
+        def g(x):
+            return x.item()
+        jax.jit(g)
+        def h(x):
+            def inner(y):
+                return np.asarray(y)
+            return inner(x)
+        out = jax.lax.cond(True, h, h, 1)
+        """, **_PKG)
+    assert sorted(f.symbol for f in fs) == ["f", "g", "h.inner"]
+    assert {f.rule for f in fs} == {"host-sync-in-jit"}
+    # float(literal) and host code outside traced scopes are fine
+    assert _lint_src(tmp_path, """
+        import jax
+        @jax.jit
+        def f(x):
+            return x + float(1e-6)
+        def host(x):
+            return float(x)
+        """, **_PKG) == []
+
+
+def test_rule_if_on_traced(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import jax
+        @jax.jit
+        def f(x, n):
+            if n > 0:
+                return x
+            return -x
+        """, **_PKG)
+    assert [f.rule for f in fs] == ["python-if-on-traced"]
+    # tree_map_with_path callbacks: the key path is static
+    assert _lint_src(tmp_path, """
+        import jax
+        @jax.jit
+        def f(tree):
+            def cb(path, leaf):
+                if path[0] == 'a':
+                    return leaf
+                return leaf * 2
+            return jax.tree_util.tree_map_with_path(cb, tree)
+        """, **_PKG) == []
+    # ... but their leaf parameter still counts
+    fs = _lint_src(tmp_path, """
+        import jax
+        @jax.jit
+        def f(tree):
+            def cb(path, leaf):
+                if leaf > 0:
+                    return leaf
+                return -leaf
+            return jax.tree_util.tree_map_with_path(cb, tree)
+        """, **_PKG)
+    assert [f.rule for f in fs] == ["python-if-on-traced"]
+
+
+def test_rule_weight_bypass(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import numpy as np
+        comm_weights = np.ones((4, 4)) / 4
+        """, **_PKG)
+    assert [f.rule for f in fs] == ["weight-matrix-bypass"]
+    # through a sanctioned helper: fine
+    assert _lint_src(tmp_path, """
+        from bluefog_tpu.optim import functional as F
+        comm_weights = F.comm_weight_inputs(specs)
+        """, **_PKG) == []
+    # authority modules construct tables from scratch by design
+    assert _lint_src(tmp_path, """
+        _WEIGHT_AUTHORITY = True
+        import numpy as np
+        comm_weights = np.ones((4, 4)) / 4
+        """, **_PKG) == []
+    # unrelated names never match
+    assert _lint_src(tmp_path, """
+        import numpy as np
+        biases = np.ones((4,))
+        """, **_PKG) == []
+
+
+def test_weight_authority_modules_are_marked():
+    """The five modules that legitimately build weight tables carry
+    the authority marker (so the rule has a principled escape hatch,
+    not an ad-hoc path list)."""
+    import bluefog_tpu.elastic.membership as m1
+    import bluefog_tpu.optim.functional as m2
+    import bluefog_tpu.parallel.collectives as m3
+    import bluefog_tpu.resilience.healing as m4
+    import bluefog_tpu.topology.spec as m5
+
+    for mod in (m1, m2, m3, m4, m5):
+        assert getattr(mod, "_WEIGHT_AUTHORITY", False) is True, mod
+
+
+def test_rule_unseeded_randomness(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import numpy as np
+        x = np.random.randn(4)
+        rng = np.random.default_rng(0)
+        y = rng.normal(size=3)
+        z = np.random.RandomState(7).rand(2)
+        """, **_BM)
+    assert [f.rule for f in fs] == ["unseeded-randomness"]
+    assert "randn" in fs[0].message
+
+
+def test_rule_unregistered_marker(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import pytest
+        @pytest.mark.slow
+        @pytest.mark.parametrize("x", [1])
+        @pytest.mark.gpu_only
+        def test_a(x):
+            pass
+        """, markers={"slow"}, **_TST)
+    assert [f.rule for f in fs] == ["unregistered-pytest-marker"]
+    assert "gpu_only" in fs[0].message
+
+
+def test_registered_markers_include_analysis():
+    marks = L.registered_markers(_REPO)
+    assert "analysis" in marks and "perf" in marks
+
+
+# ------------------------------------------------------------------ #
+# baseline round-trip
+# ------------------------------------------------------------------ #
+
+def test_baseline_round_trip(tmp_path):
+    f1 = Finding("env-read-outside-config", "bluefog_tpu/a.py", 12,
+                 "f", "msg")
+    f2 = Finding("host-sync-in-jit", "bluefog_tpu/b.py", 3, "g", "msg")
+    base = tmp_path / "baseline.txt"
+    base.write_text(f"# comment line\n{f1.key()}  # vetted because X\n")
+    keys = load_baseline(str(base))
+    assert keys == [f1.key()]
+    active, suppressed = split_suppressed([f1, f2], keys)
+    assert suppressed == [f1] and active == [f2]
+    # key stability: the line number is NOT part of the key
+    f1_moved = Finding(f1.rule, f1.path, 99, f1.symbol, f1.message)
+    active, suppressed = split_suppressed([f1_moved], keys)
+    assert suppressed == [f1_moved] and active == []

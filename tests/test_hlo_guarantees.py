@@ -172,16 +172,15 @@ def test_compiled_schedule_lowers_to_predicted_permutes_and_bytes(mesh):
                        out_specs=P("bf"), check_vma=False)
     x = jnp.zeros((N, 64), jnp.float32)
     hlo = _compiled_hlo(sm, x, jnp.asarray(0))
-    wins = [w for w in BU.scheduled_collective_windows(hlo)
-            if w["kind"] == "collective-permute"]
-    assert len(wins) == pred["permutes_per_period"]
-    assert all(w["bytes"] == payload for w in wins)
-    assert sum(w["bytes"] for w in wins) == pred["bytes_per_period"]
+    # thin wrapper over the supported contract check (count, per-permute
+    # payload, total bytes — the assertions this test used to hand-roll)
+    assert BU.verify_collective_contract(hlo, pred, payload) == []
     # and per round: lowering each branch alone reproduces the
     # per-round permute counts the cost model charged
-    for rnd, rp in zip(schedule, pred["per_round"]):
+    for i, rnd in enumerate(schedule):
         hlo_r = _compiled_hlo(_sharded_combine(mesh, rnd), x)
-        assert _count_permutes(hlo_r) == rp["permutes"]
+        assert BU.verify_collective_contract(
+            hlo_r, pred, payload, round_index=i) == []
 
 
 # --- hierarchical two-level exchange: the wire-pattern guarantees ---
@@ -250,16 +249,14 @@ def test_compiled_hierarchical_lowers_to_predictions(mesh):
     assert pred["all_reduces_per_period"] == len(compiled.machine_schedule)
     assert pred["all_reduce_group_size"] == 2
     x = jnp.zeros((N, 64), jnp.float32)
-    total = 0
-    for rnd, rp in zip(compiled.machine_schedule, pred["per_round"]):
+    # thin wrapper: each round held to per_round[i] (one grouped reduce
+    # with the machine replica_groups + predicted permutes/bytes); the
+    # per-period total follows because the contract check also verifies
+    # the prediction's per-round/per-period internal consistency
+    for i, rnd in enumerate(compiled.machine_schedule):
         hlo = _compiled_hlo(_sharded_hier(mesh, rnd, 2), x)
-        assert _count_reduces(hlo) == rp["all_reduces"] == 1
-        wins = [w for w in BU.scheduled_collective_windows(hlo)
-                if w["kind"] == "collective-permute"]
-        assert len(wins) == rp["permutes"]
-        assert all(w["bytes"] == payload for w in wins)
-        total += sum(w["bytes"] for w in wins)
-    assert total == pred["bytes_per_period"]
+        assert BU.verify_collective_contract(
+            hlo, pred, payload, round_index=i) == []
 
 
 def test_pipeline_is_one_permute_per_tick(mesh):
