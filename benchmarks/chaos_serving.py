@@ -1,0 +1,469 @@
+"""Serving chaos: replica death, token-exact failover, graceful drain.
+
+The machine-checked acceptance artifact of serving-side fault tolerance
+(ISSUE 14).  Three experiments over one seeded Poisson trace, in the
+same lockstep virtual-time fleet simulation as ``fleet_serving.py``
+(per-step device cost measured once on the real engine, then every busy
+replica steps concurrently per tick):
+
+* **fault_free** — the reference: 3 replicas behind the gossip-fed
+  :class:`~bluefog_tpu.serving.FleetRouter`, sharing one prefix cache,
+  serving the trace to completion.  Its per-request outputs are the
+  bit-exactness oracle for the chaos run.
+* **chaos_serving** — the SAME trace, but replica ``--victim`` dies at
+  engine step ``--fault-step`` (a deterministic
+  :class:`~bluefog_tpu.resilience.ServingFaultPlan`, injected by
+  :class:`~bluefog_tpu.serving.FaultyReplica` — host-side control flow
+  only).  The dead replica rejects submits (the router walks past it
+  and records the cause), its step heartbeat goes stale (the router's
+  staleness guard marks it suspect and excises it from the walk), and
+  its stranded residents — mid-prefill, mid-decode, and queued — fail
+  over through :func:`~bluefog_tpu.serving.failover_stranded` onto the
+  survivors, replaying emitted tokens through the prefix-cache chain.
+  Machine-checked claims: **zero lost requests**, **completed tokens
+  bit-equal to the fault-free run** (greedy and sampled alike),
+  **TTFT p99 degradation bounded** (``--ttft-degradation``×), and
+  **fleet tokens/s recovery** ≥ (N−1)/N·(1−``--recovery-slack``) of the
+  pre-fault rate.
+* **drain** — ``ServingEngine.drain(handoff=...)``: a replica with
+  mixed prefill/decode residents and a queue stops admitting, flushes
+  its written K/V chunks to the shared prefix cache, and hands every
+  request off; the target finishes them bit-equal to an undrained run.
+
+A transient-rejection scenario additionally checks that router retries
+(seeded exponential backoff) absorb a 1-step submit-reject window
+without surfacing ``FleetSaturated``.  Throughout ALL of it the
+resident jit caches must not grow (``recompiles == 0``): every fault,
+failover, and drain is host-side control flow.
+
+``machine_checked`` in the emitted record carries the pass/fail of each
+claim; any failure exits 1.  Gates against the committed
+``benchmarks/chaos_serving_r15.json`` by default (``--compare ''`` to
+disable).
+
+  JAX_PLATFORMS=cpu python benchmarks/chaos_serving.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bluefog_tpu import models
+from bluefog_tpu.benchutil import poisson_arrivals
+from bluefog_tpu.observe.registry import MetricsRegistry
+from bluefog_tpu.resilience import ServingFaultPlan
+from bluefog_tpu.serving import (FaultyReplica, FleetRouter, PrefixCache,
+                                 Request, ServingEngine, failover_stranded,
+                                 percentile)
+from bluefog_tpu.serving.engine import (_decode_step_prog,
+                                        _prefill_chunk_prog)
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "chaos_serving_r15.json")
+
+parser = argparse.ArgumentParser(description=__doc__)
+parser.add_argument("--num-requests", type=int, default=24)
+parser.add_argument("--n-replicas", type=int, default=3)
+parser.add_argument("--victim", type=int, default=1,
+                    help="replica killed in the chaos run (not 0: rank "
+                         "0 anchors the router's gossip)")
+parser.add_argument("--fault-step", type=int, default=12,
+                    help="victim engine step at which the replica-death "
+                         "fault fires (mid-run for the default trace)")
+parser.add_argument("--arrivals-per-step", type=float, default=2.0,
+                    help="mean Poisson arrivals per engine step of "
+                         "virtual time; saturates the 3-replica fleet "
+                         "around the fault so the recovery window "
+                         "measures steady-state decode throughput")
+parser.add_argument("--capacity", type=int, default=3)
+parser.add_argument("--max-len", type=int, default=96)
+parser.add_argument("--prefill-chunk", type=int, default=8)
+parser.add_argument("--prompt-len", type=int, nargs=2, default=(4, 24))
+parser.add_argument("--new-tokens", type=int, nargs=2, default=(10, 20))
+parser.add_argument("--rate-window", type=int, default=6,
+                    help="ticks per throughput window (pre-fault window "
+                         "ends at the fault; post-fault window starts "
+                         "after --settle-ticks)")
+parser.add_argument("--settle-ticks", type=int, default=3,
+                    help="ticks after the fault excluded from the "
+                         "recovery window (failover + re-prefill)")
+parser.add_argument("--recovery-slack", type=float, default=0.25,
+                    help="slack on the (N-1)/N recovery floor")
+parser.add_argument("--ttft-degradation", type=float, default=5.0,
+                    help="chaos TTFT p99 must stay within this factor "
+                         "of the fault-free run's")
+parser.add_argument("--seed", type=int, default=0)
+parser.add_argument("--dim", type=int, default=128)
+parser.add_argument("--layers", type=int, default=4)
+parser.add_argument("--out", default="chaos_serving_r15.json")
+parser.add_argument("--compare", metavar="PREV.json",
+                    default=(DEFAULT_BASELINE
+                             if os.path.exists(DEFAULT_BASELINE)
+                             else None),
+                    help="regression gate (default: the committed "
+                         "benchmarks/chaos_serving_r15.json when "
+                         "present; pass '' to disable)")
+parser.add_argument("--tolerance", type=float, default=0.25,
+                    help="gate tolerance (loose: the virtual-time "
+                         "numbers scale with this host's measured "
+                         "step cost).  lost_requests gates at zero "
+                         "tolerance regardless")
+
+
+def parse_args(argv=None):
+    args = parser.parse_args(argv)
+    if args.compare == "":
+        args.compare = None
+    return args
+
+
+class _Clock:
+    """The fleet simulation's shared virtual clock (injected into every
+    replica, so TTFT percentiles and staleness ages come out of the
+    engines' own metrics in virtual seconds)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_trace(args):
+    rs = np.random.RandomState(args.seed + 1)
+    arrivals = poisson_arrivals(1.0, args.num_requests, args.seed)
+    lens = rs.randint(args.prompt_len[0], args.prompt_len[1] + 1,
+                      args.num_requests)
+    budgets = rs.randint(args.new_tokens[0], args.new_tokens[1] + 1,
+                         args.num_requests)
+    prompts = [rs.randint(0, 256, (n,)).astype(np.int32) for n in lens]
+    # alternate greedy and sampled requests: the sampled half proves
+    # failover continues the per-request rng fold chain bit-exactly,
+    # not just the argmax
+    temps = [(0.0, 0.8)[i % 2] for i in range(args.num_requests)]
+    return arrivals, prompts, budgets, temps
+
+
+def _requests(trace):
+    _, prompts, budgets, temps = trace
+    return [Request(p, int(b), temperature=t, seed=1000 + i)
+            for i, (p, b, t) in enumerate(zip(prompts, budgets, temps))]
+
+
+def measure_step_cost(variables, cfg, args):
+    """Median wall cost of one real engine step under full slots — the
+    per-tick device cost every simulated replica pays.  Also warms the
+    resident programs, so the recompile count can be snapshotted before
+    any chaos."""
+    eng = ServingEngine(variables, cfg, capacity=args.capacity,
+                        max_len=args.max_len,
+                        prefill_chunk=args.prefill_chunk,
+                        registry=MetricsRegistry())
+    rs = np.random.RandomState(args.seed + 2)
+    for _ in range(args.capacity):
+        eng.submit(Request(
+            rs.randint(0, 256, (args.prompt_len[1],)).astype(np.int32),
+            args.new_tokens[1], temperature=0.8, seed=7))
+    eng.step()
+    times = []
+    while True:
+        t0 = time.perf_counter()
+        busy = eng.step()
+        times.append(time.perf_counter() - t0)
+        if not busy:
+            break
+    return float(np.median(times))
+
+
+def run_fleet(variables, cfg, args, trace, step_cost, plan=None):
+    """Serve the trace on ``args.n_replicas`` simulated replicas behind
+    the real router, all sharing one prefix cache.  With a ``plan``,
+    every replica runs behind a :class:`FaultyReplica` wrapper; replica
+    death triggers :func:`failover_stranded` back through the router.
+
+    Returns the section record plus the request list (the bit-exactness
+    oracle / subject)."""
+    n = args.n_replicas
+    arrivals = trace[0]
+    clock = _Clock()
+    prefix = PrefixCache(args.prefill_chunk, 1 << 28)
+    regs = [MetricsRegistry() for _ in range(n)]
+    engines = [ServingEngine(variables, cfg, capacity=args.capacity,
+                             max_len=args.max_len,
+                             prefill_chunk=args.prefill_chunk,
+                             max_queue=args.num_requests,
+                             prefix_cache=prefix,
+                             clock=clock, registry=regs[i])
+               for i in range(n)]
+    if plan is not None:
+        reps = [FaultyReplica(e, plan, i,
+                              sleep=lambda s: None)  # stalls in vt
+                for i, e in enumerate(engines)]
+    else:
+        reps = engines
+    router = FleetRouter(reps, registries=regs, clock=clock,
+                         stale_after=2.5 * step_cost,
+                         retries=2, retry_base_s=step_cost / 8,
+                         sleep=lambda s: None, seed=args.seed)
+    reqs = _requests(trace)
+    pending = list(range(len(reqs)))
+    failed_over = False
+    suspect_seen = False
+    tick = 0
+    tokens_at_tick = []  # cumulative emitted tokens, indexed by tick
+    while not all(r.done for r in reqs):
+        while pending and arrivals[pending[0]] <= clock.t:
+            i = pending.pop(0)
+            router.submit(reqs[i])
+        busy = False
+        for rep in reps:
+            busy = rep.step() or busy
+        if plan is not None and not failed_over \
+                and getattr(reps[args.victim], "dead", False):
+            # the victim's device is gone: move its residents (mid-
+            # prefill, mid-decode, queued) onto the survivors through
+            # the normal router walk — the dead replica rejects its own
+            # readmission, and once its heartbeat is stale the walk
+            # skips it outright
+            moved, expired = failover_stranded(
+                reps[args.victim], lambda r: router.submit(r))
+            assert not expired, "trace deadlines are unset"
+            failed_over = True
+        snap = router.poll()
+        suspect_seen = suspect_seen or any(snap.suspect)
+        tokens_at_tick.append(sum(len(r.tokens) for r in reqs))
+        clock.t += step_cost
+        tick += 1
+        if not busy and not pending:
+            break
+        if not busy and pending:
+            clock.t = max(clock.t, arrivals[pending[0]])
+        if tick > 10_000:
+            raise RuntimeError("fleet simulation did not converge")
+    completed = sum(r.state == "completed" for r in reqs)
+    lost = len(reqs) - completed
+    ttft = [t for e in engines for t in e.metrics.ttfts()]
+    makespan = clock.t
+    useful = sum(len(r.tokens) for r in reqs)
+    rec = {
+        "n_replicas": n,
+        "step_cost_s": step_cost,
+        "tokens_per_sec": useful / makespan,
+        "useful_tokens": int(useful),
+        "makespan_s": makespan,
+        "ttft_p50": percentile(ttft, 50),
+        "ttft_p99": percentile(ttft, 99),
+        "completed": int(completed),
+        "lost_requests": int(lost),
+        "ticks": tick,
+    }
+    if plan is not None:
+        rec["failovers"] = sum(e.metrics.summary()["n_failovers"]
+                               for e in engines)
+        rec["suspect_detected"] = bool(suspect_seen)
+        rec["prefix_chunks_restored"] = sum(
+            e.metrics.summary()["prefix_chunks_restored"]
+            for e in engines)
+    return rec, reqs, tokens_at_tick
+
+
+def rate(tokens_at_tick, t0, t1, step_cost):
+    """Mean fleet tokens/s of virtual time over ticks [t0, t1)."""
+    t1 = min(t1, len(tokens_at_tick) - 1)
+    t0 = max(0, min(t0, t1 - 1))
+    return ((tokens_at_tick[t1] - tokens_at_tick[t0])
+            / ((t1 - t0) * step_cost))
+
+
+def run_drain(variables, cfg, args):
+    """drain(handoff=...) with mixed prefill/decode residents and a
+    queue: zero lost, flushed K/V restored on the target, outputs
+    bit-equal to an undrained run."""
+    rs = np.random.RandomState(args.seed + 5)
+    prompts = [rs.randint(0, 256, (int(n),)).astype(np.int32)
+               for n in rs.randint(args.prompt_len[0],
+                                   args.prompt_len[1] + 1, 6)]
+    budgets = rs.randint(args.new_tokens[0], args.new_tokens[1] + 1, 6)
+
+    def mk():
+        return [Request(p, int(b), temperature=(0.0, 0.8)[i % 2],
+                        seed=500 + i)
+                for i, (p, b) in enumerate(zip(prompts, budgets))]
+
+    ref_eng = ServingEngine(variables, cfg, capacity=args.capacity,
+                            max_len=args.max_len,
+                            prefill_chunk=args.prefill_chunk,
+                            max_queue=8, registry=MetricsRegistry())
+    ref = [ref_eng.submit(r) for r in mk()]
+    ref_eng.run()
+
+    prefix = PrefixCache(args.prefill_chunk, 1 << 28)
+    e0 = ServingEngine(variables, cfg, capacity=args.capacity,
+                       max_len=args.max_len,
+                       prefill_chunk=args.prefill_chunk, max_queue=8,
+                       prefix_cache=prefix, registry=MetricsRegistry())
+    e1 = ServingEngine(variables, cfg, capacity=args.capacity,
+                       max_len=args.max_len,
+                       prefill_chunk=args.prefill_chunk, max_queue=8,
+                       prefix_cache=prefix, registry=MetricsRegistry())
+    live = [e0.submit(r) for r in mk()]
+    for _ in range(4):  # residents mid-prefill AND mid-decode + queue
+        e0.step()
+    summary = e0.drain(handoff=e1.submit)
+    e1.run()
+    exact = all(np.array_equal(a.output(), b.output())
+                for a, b in zip(live, ref))
+    return {
+        "handed_off": summary["handed_off"],
+        "completed_in_place": summary["completed"],
+        "flushed_chunks": summary["flushed_chunks"],
+        "chunks_restored_on_target":
+            e1.metrics.summary()["prefix_chunks_restored"],
+        "lost_requests": sum(r.state != "completed" for r in live),
+        "bitwise_exact": bool(exact),
+    }
+
+
+def check_retry_absorbs(variables, cfg, args):
+    """A 1-step submit-reject window on every replica: the first walk
+    fails whole, the seeded backoff retry lands the request."""
+    clock = _Clock()
+    regs = [MetricsRegistry() for _ in range(2)]
+    engines = [ServingEngine(variables, cfg, capacity=2, max_len=32,
+                             prefill_chunk=args.prefill_chunk,
+                             max_queue=4, clock=clock, registry=regs[i])
+               for i in range(2)]
+    plan = ServingFaultPlan.submit_rejection(2, 0, step=0, duration=1) \
+        .merged(ServingFaultPlan.submit_rejection(2, 1, step=0,
+                                                  duration=1))
+    reps = []
+
+    def vsleep(dt):  # backoff in virtual time; the fleet keeps stepping
+        clock.t += dt
+        for rep in reps:
+            rep.step()
+
+    reps[:] = [FaultyReplica(e, plan, i) for i, e in enumerate(engines)]
+    router = FleetRouter(reps, registries=regs, clock=clock, retries=2,
+                         retry_base_s=0.01, sleep=vsleep, seed=args.seed)
+    try:
+        router.submit(Request(np.arange(6, dtype=np.int32), 2))
+        return True
+    except Exception:
+        return False
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32, dim=args.dim,
+                                  n_layers=args.layers,
+                                  hidden_dim=2 * args.dim)
+    variables = models.Llama(cfg).init(jax.random.PRNGKey(1),
+                                       jnp.zeros((2, 4), jnp.int32))
+    trace = make_trace(args)
+    for p, b in zip(trace[1], trace[2]):
+        assert p.size + b <= args.max_len
+
+    step_cost = measure_step_cost(variables, cfg, args)
+    arrivals = trace[0] * (step_cost / args.arrivals_per_step)
+    trace = (arrivals,) + trace[1:]
+
+    fault_free, ref_reqs, _ = run_fleet(variables, cfg, args, trace,
+                                        step_cost)
+    # everything is warm now: any later compile is a contract breach
+    n_prefill0 = _prefill_chunk_prog._cache_size()
+    n_decode0 = _decode_step_prog._cache_size()
+
+    plan = ServingFaultPlan.replica_death(args.n_replicas, args.victim,
+                                          step=args.fault_step)
+    chaos, chaos_reqs, toks = run_fleet(variables, cfg, args, trace,
+                                        step_cost, plan=plan)
+    w, s = args.rate_window, args.settle_ticks
+    pre = rate(toks, args.fault_step - w, args.fault_step, step_cost)
+    post = rate(toks, args.fault_step + s, args.fault_step + s + w,
+                step_cost)
+    chaos["pre_fault_tokens_per_sec"] = pre
+    chaos["post_fault_tokens_per_sec"] = post
+    chaos["throughput_recovery"] = post / pre if pre else 0.0
+    exact = all(np.array_equal(a.output(), b.output())
+                for a, b in zip(chaos_reqs, ref_reqs))
+    chaos["bitwise_exact"] = bool(exact)
+
+    drain = run_drain(variables, cfg, args)
+    retry_ok = check_retry_absorbs(variables, cfg, args)
+    recompiles = ((_prefill_chunk_prog._cache_size() - n_prefill0)
+                  + (_decode_step_prog._cache_size() - n_decode0))
+
+    n = args.n_replicas
+    floor = (n - 1) / n * (1.0 - args.recovery_slack)
+    machine_checked = {
+        "chaos_zero_lost": chaos["lost_requests"] == 0,
+        "chaos_token_exact": chaos["bitwise_exact"],
+        "chaos_failover_fired": chaos["failovers"] > 0,
+        "chaos_suspect_detected": chaos["suspect_detected"],
+        "chaos_ttft_p99_bounded": (chaos["ttft_p99"]
+                                   <= args.ttft_degradation
+                                   * fault_free["ttft_p99"]),
+        "chaos_throughput_recovers":
+            chaos["throughput_recovery"] >= floor,
+        "retry_absorbs_transient": retry_ok,
+        "drain_zero_lost": drain["lost_requests"] == 0,
+        "drain_token_exact": drain["bitwise_exact"],
+        "drain_flushes_kv": drain["flushed_chunks"] > 0,
+        "zero_recompiles": recompiles == 0,
+    }
+    rec = {
+        "bench": "chaos_serving",
+        "config": {
+            "model": f"tiny(dim={args.dim},layers={args.layers})",
+            "num_requests": args.num_requests,
+            "n_replicas": args.n_replicas, "victim": args.victim,
+            "fault_step": args.fault_step,
+            "arrivals_per_step": args.arrivals_per_step,
+            "capacity": args.capacity, "max_len": args.max_len,
+            "prefill_chunk": args.prefill_chunk,
+            "recovery_floor": floor,
+            "ttft_degradation": args.ttft_degradation,
+            "seed": args.seed,
+            "backend": jax.default_backend(),
+        },
+        "fault_free": fault_free,
+        "chaos_serving": chaos,
+        "drain": drain,
+        "recompiles": int(recompiles),
+        "machine_checked": machine_checked,
+    }
+    print(json.dumps(rec, indent=2))
+    failed = [k for k, v in machine_checked.items() if not v]
+    if failed:
+        print(f"[chaos-serving] FAILED claims: {failed}")
+        return 1
+    # gate BEFORE writing --out (rolling-baseline discipline); lost
+    # requests gate at zero tolerance — a lost request is never noise
+    if args.compare:
+        from bluefog_tpu.benchutil import bench_regression_gate
+
+        if not bench_regression_gate(
+                rec, args.compare, tolerance=args.tolerance,
+                tolerances={"chaos_serving.lost_requests": 0.0,
+                            "drain.lost_requests": 0.0,
+                            "fault_free.lost_requests": 0.0}):
+            print(f"[bench-gate] regression: NOT writing {args.out}")
+            return 1
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

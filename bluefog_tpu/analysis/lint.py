@@ -34,6 +34,12 @@ and by scattered tests; the lint makes them mechanical:
     ``pytest.mark.<name>`` in ``tests/`` not declared in
     ``pyproject.toml`` — with ``--strict-markers`` ambitions, a typo'd
     marker silently deselects tests.
+``sleep-without-backoff``
+    ``time.sleep`` inside a loop under ``bluefog_tpu/serving/``.  Every
+    serving retry loop must sleep through the seeded-backoff helper
+    (``serving.resilience.backoff_sleep``): deterministic delays keyed
+    on (seed, request, attempt) are what make chaos runs replayable and
+    keep retry storms from synchronizing across replicas.
 
 Pure-syntactic by design: no imports of the scanned modules, so the
 lint runs in milliseconds and can't be confused by import-time side
@@ -416,6 +422,43 @@ class _UnseededRandomVisitor(_ScopeTracker):
 
 
 # --------------------------------------------------------------------- #
+# rule: sleep-without-backoff (bluefog_tpu/serving/)
+# --------------------------------------------------------------------- #
+
+class _SleepInLoopVisitor(_ScopeTracker):
+    """``time.sleep`` inside a ``for``/``while`` under the serving
+    package is a hand-rolled retry loop: it must go through
+    ``serving.resilience.backoff_sleep`` (seeded, jittered,
+    deterministic).  Injected sleeps (``self._sleep``, a ``sleep=``
+    parameter) are fine — determinism is the caller's choice there."""
+
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self.path = path
+        self.loop_depth = 0
+        self.findings: List[Finding] = []
+
+    def _loop(self, node) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = _loop
+    visit_AsyncFor = _loop
+    visit_While = _loop
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.loop_depth > 0 and _dotted(node.func) == "time.sleep":
+            self.findings.append(Finding(
+                "sleep-without-backoff", self.path, node.lineno,
+                self.symbol,
+                "time.sleep in a serving retry loop; use "
+                "serving.resilience.backoff_sleep (seeded exponential "
+                "backoff) so delays are deterministic and de-synced"))
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------- #
 # rule: unregistered-pytest-marker (tests/)
 # --------------------------------------------------------------------- #
 
@@ -467,15 +510,21 @@ class _MarkerVisitor(_ScopeTracker):
 
 def lint_file(path: str, rel: str, *, markers: Set[str],
               in_package: bool, in_benchmarks: bool,
-              in_tests: bool) -> List[Finding]:
+              in_tests: bool,
+              in_serving: Optional[bool] = None) -> List[Finding]:
     """All findings for one file.  ``rel`` is the repo-relative posix
     path recorded on the findings; the ``in_*`` flags select which rule
-    families apply (set by :func:`run_lint` from the file's location)."""
+    families apply (set by :func:`run_lint` from the file's location).
+    ``in_serving`` defaults from ``rel`` (files under
+    ``bluefog_tpu/serving/``); pass it explicitly to force the rule on
+    a fixture."""
     try:
         tree = ast.parse(open(path).read(), filename=path)
     except SyntaxError as e:
         return [Finding("syntax-error", rel, e.lineno or 0, "<module>",
                         f"file does not parse: {e.msg}")]
+    if in_serving is None:
+        in_serving = rel.startswith("bluefog_tpu/serving/")
     findings: List[Finding] = []
     if in_package:
         if os.path.basename(path) != "config.py":
@@ -490,6 +539,10 @@ def lint_file(path: str, rel: str, *, markers: Set[str],
             wv = _WeightBypassVisitor(rel)
             wv.visit(tree)
             findings += wv.findings
+    if in_serving:
+        sv = _SleepInLoopVisitor(rel)
+        sv.visit(tree)
+        findings += sv.findings
     if in_benchmarks:
         rv = _UnseededRandomVisitor(rel)
         rv.visit(tree)

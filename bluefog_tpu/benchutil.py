@@ -790,7 +790,8 @@ _HEADLINE_HIGHER = ("value", "mfu", "tokens_per_sec", "useful_tokens",
                     "throughput_recovery", "tp_overlap_fraction")
 _HEADLINE_LOWER = ("ttft_p50", "ttft_p99", "latency_p50", "latency_p99",
                    "makespan_s", "p99", "p50", "cost_to_consensus",
-                   "post_rejoin_floor", "dcn_bytes_per_step")
+                   "post_rejoin_floor", "dcn_bytes_per_step",
+                   "lost_requests")
 
 
 def bench_headline(record: dict) -> dict:
@@ -815,7 +816,8 @@ def bench_headline(record: dict) -> dict:
     for section in ("continuous", "static", "chaos", "straggler",
                     "rejoin", "pod_4x8", "pod_8x16", "fleet_one",
                     "fleet_two", "prefix", "speculative",
-                    "hierarchical"):
+                    "hierarchical", "fault_free", "chaos_serving",
+                    "drain"):
         if isinstance(record.get(section), dict):
             grab(record[section], section + ".")
     return out

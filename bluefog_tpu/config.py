@@ -30,6 +30,10 @@ __all__ = [
     "hier_local_size",
     "kv_zero_on_free",
     "prefix_cache_mb",
+    "replica_stale_s",
+    "router_retries",
+    "router_retry_base_s",
+    "router_cooldown_s",
     "elastic_bootstrap_rounds",
     "elastic_quarantine_threshold",
     "coordinator",
@@ -187,6 +191,55 @@ def prefix_cache_mb() -> int:
         return int(_env("BLUEFOG_PREFIX_CACHE_MB", "64"))
     except ValueError:
         return 64
+
+
+def replica_stale_s() -> float:
+    """BLUEFOG_REPLICA_STALE_S (seconds, default 0 = disabled): serving
+    fleet staleness guard.  A replica that has not published a step
+    heartbeat (``bf_serving_last_step_ts``) within this window is marked
+    *suspect* by :class:`bluefog_tpu.serving.FleetRouter` — its gossip
+    row is masked out and its score pinned to +inf, exactly like the
+    explicit dead-mask path — until it steps again.  Replicas that have
+    never stepped are exempt (cold replicas must stay routable)."""
+    try:
+        return float(_env("BLUEFOG_REPLICA_STALE_S", "0"))
+    except ValueError:
+        return 0.0
+
+
+def router_retries() -> int:
+    """BLUEFOG_ROUTER_RETRIES (default 0): extra full-fleet walks
+    :meth:`FleetRouter.submit` makes after the first walk exhausts every
+    live replica, separated by seeded exponential backoff
+    (:func:`bluefog_tpu.serving.resilience.backoff_sleep`).  0 keeps the
+    historical single-walk behavior: one pass, then ``FleetSaturated``."""
+    try:
+        return max(0, int(_env("BLUEFOG_ROUTER_RETRIES", "0")))
+    except ValueError:
+        return 0
+
+
+def router_retry_base_s() -> float:
+    """BLUEFOG_ROUTER_RETRY_BASE_S (seconds, default 0.05): base delay of
+    the router's seeded exponential backoff between submit retry walks
+    (attempt k sleeps ~ base * 2**k, jittered deterministically from the
+    router seed and request id)."""
+    try:
+        return float(_env("BLUEFOG_ROUTER_RETRY_BASE_S", "0.05"))
+    except ValueError:
+        return 0.05
+
+
+def router_cooldown_s() -> float:
+    """BLUEFOG_ROUTER_COOLDOWN_S (seconds, default 0 = disabled): after a
+    replica rejects repeated submits, the router demotes it to the back
+    of the candidate walk for this long.  Cooldown only re-orders the
+    walk — a cooling replica is still tried last, so cooldown can never
+    manufacture a ``FleetSaturated`` on its own."""
+    try:
+        return float(_env("BLUEFOG_ROUTER_COOLDOWN_S", "0"))
+    except ValueError:
+        return 0.0
 
 
 def elastic_bootstrap_rounds() -> int:

@@ -37,6 +37,8 @@ from bluefog_tpu.resilience.faults import (  # noqa: F401
     Fault,
     FaultPlan,
     PREEMPT,
+    ServingFault,
+    ServingFaultPlan,
 )
 from bluefog_tpu.resilience.detector import (  # noqa: F401
     FailureDetector,
@@ -68,6 +70,8 @@ __all__ = [
     "Fault",
     "FaultPlan",
     "PREEMPT",
+    "ServingFault",
+    "ServingFaultPlan",
     "FailureDetector",
     "update_health",
     "consensus_simulation",

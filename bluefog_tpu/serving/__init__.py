@@ -21,10 +21,14 @@ from bluefog_tpu.serving.fleet import (FleetRouter, FleetSaturated,
 from bluefog_tpu.serving.kv_pool import SlotPool
 from bluefog_tpu.serving.metrics import ServingMetrics, percentile
 from bluefog_tpu.serving.prefix_cache import PrefixCache
+from bluefog_tpu.serving.resilience import (FaultyReplica, backoff_sleep,
+                                            failover_stranded,
+                                            seeded_backoff)
 from bluefog_tpu.serving.scheduler import FifoScheduler
 
 __all__ = ["ServingEngine", "Request", "RequestRejected",
            "SpeculativeConfig", "SlotPool", "PrefixCache",
            "FleetRouter", "FleetSaturated", "RouterSnapshot",
            "collect_serving_signals", "FifoScheduler", "ServingMetrics",
-           "percentile"]
+           "percentile", "FaultyReplica", "failover_stranded",
+           "seeded_backoff", "backoff_sleep"]

@@ -73,13 +73,19 @@ from bluefog_tpu.serving.metrics import ServingMetrics
 from bluefog_tpu.serving.scheduler import FifoScheduler, RequestRejected
 
 __all__ = ["ServingEngine", "Request", "RequestRejected",
-           "SpeculativeConfig"]
+           "SpeculativeConfig", "EXPIRED", "FAILOVER"]
 
 _rid_counter = itertools.count()
 
 # terminal / live request states
 QUEUED, PREFILL, DECODE = "queued", "prefill", "decode"
 COMPLETED, CANCELLED, REJECTED = "completed", "cancelled", "rejected"
+# EXPIRED: terminal — deadline passed while the request was stranded on
+# a dead/draining replica (the queue-shedding path stays CANCELLED).
+# FAILOVER: transitional retire outcome — the slot is released here but
+# the request immediately resets to QUEUED for resubmission elsewhere,
+# so ``done`` stays False.
+EXPIRED, FAILOVER = "expired", "failover"
 
 
 @dataclasses.dataclass(eq=False)  # identity semantics: the scheduler
@@ -122,13 +128,27 @@ class Request:
 
     @property
     def done(self) -> bool:
-        return self.state in (COMPLETED, CANCELLED, REJECTED)
+        return self.state in (COMPLETED, CANCELLED, REJECTED, EXPIRED)
 
     def output(self) -> np.ndarray:
         """prompt ‖ generated tokens (no padding — streaming semantics:
         exactly what was emitted, EOS included when it fired)."""
         return np.concatenate(
             [self.prompt, np.asarray(self.tokens, np.int32)])
+
+    def reset_for_resume(self) -> "Request":
+        """Return the request to the submittable QUEUED state while
+        KEEPING its emitted tokens — the failover/drain primitive.  The
+        next engine re-prefills ``prompt ‖ tokens`` (cached chunks by
+        chain hash, cold tail otherwise) and its decode continues the
+        rng fold chain at ``len(tokens)``, so the resumed stream is
+        bit-equal to an unfaulted run."""
+        self.state = QUEUED
+        self.slot = None
+        self._prefill_pos = 0
+        self._cancel = False
+        self._prefix_keys = None
+        return self
 
 
 def _sample(logits, key, temp):
@@ -504,6 +524,9 @@ class ServingEngine:
         self._params = variables["params"]
         self._running: Dict[int, Request] = {}   # slot -> request
         self._admitting: Optional[Request] = None  # mid-prefill request
+        self._draining = False     # drain(): admission permanently off
+        self._drain_flushed = 0    # KV chunks flushed to the prefix
+        # cache on behalf of migrating/completing drain residents
         self._resident = self._build_resident()
 
     # -- submission ---------------------------------------------------- #
@@ -534,12 +557,21 @@ class ServingEngine:
                 + (f" + speculative headroom {self._spec.lookahead}"
                    if self._spec is not None else "") + ")")
         now = self.clock()
+        if self._draining:
+            request.state = REJECTED
+            self.metrics.on_reject(request.rid, now)
+            raise RequestRejected("engine draining",
+                                  queue_depth=self.scheduler.queue_depth,
+                                  max_queue=self.scheduler.max_queue)
         try:
             self.scheduler.submit(request)
         except RequestRejected:
             request.state = REJECTED
             self.metrics.on_reject(request.rid, now)
             raise
+        # a request one replica refused may be accepted by the next in
+        # the router's walk — acceptance supersedes the earlier REJECTED
+        request.state = QUEUED
         self.metrics.on_submit(request.rid, now)
         return request
 
@@ -582,6 +614,9 @@ class ServingEngine:
         chunks = 0
         while chunks < self.prefill_budget:
             if self._admitting is None:
+                if self._draining:
+                    break  # drain(): the current prefill finishes, but
+                    # nothing new leaves the queue
                 if self.pool.n_free == 0:
                     break
                 req = self.scheduler.admit(now)
@@ -592,9 +627,14 @@ class ServingEngine:
                     dslot = self._draft_pool.alloc()
                     assert dslot == req.slot, (dslot, req.slot)
                 self.metrics.on_admit(req.rid, now)
-                if req.prompt.size > 1:
+                # a failed-over request resumes with emitted tokens: its
+                # prefill region is (prompt ‖ tokens)[:-1] — the same
+                # chunk grid the original prefill stashed, so the replay
+                # restores cached chunks and computes only the tail
+                n_ctx = req.prompt.size + len(req.tokens)
+                if n_ctx > 1:
                     self._restore_prefix(req)  # no-op without the cache
-                    if req._prefill_pos >= req.prompt.size - 1:
+                    if req._prefill_pos >= n_ctx - 1:
                         # the whole prefill region came out of the
                         # prefix cache — straight to decode, zero
                         # prefill compute spent
@@ -620,7 +660,7 @@ class ServingEngine:
                 self._decode_step(decoding)
         self.metrics.on_step(self.pool.occupancy(),
                              self.scheduler.queue_depth,
-                             time.perf_counter() - t_step)
+                             time.perf_counter() - t_step, now=now)
         return bool(self._running or self._admitting
                     or self.scheduler.queue_depth)
 
@@ -632,6 +672,74 @@ class ServingEngine:
             if not self.step():
                 return
         raise RuntimeError(f"engine still busy after {max_steps} steps")
+
+    def drain(self, handoff: Optional[Callable[[Request], object]] = None,
+              max_steps: int = 100_000) -> Dict[str, int]:
+        """Retire this replica cleanly — the elastic-serving primitive.
+
+        Admission stops permanently (subsequent :meth:`submit` raises
+        :class:`RequestRejected`; the admission loop stops popping the
+        queue).  Then:
+
+        * with a ``handoff`` callable (e.g. ``router.submit``): every
+          resident request flushes its written K/V chunks to the shared
+          prefix cache, retires here with outcome ``failover``, resets
+          to QUEUED **keeping its emitted tokens**, and is handed off —
+          the target replica re-prefills ``prompt ‖ tokens`` (restored
+          chunks + novel tail) and continues bit-exactly.  Queued
+          requests hand off as-is.
+        * without one: queued requests are REJECTED (backpressure — the
+          caller resubmits elsewhere), residents run to completion in
+          place, flushing their chunks as they retire.
+
+        Host-side control flow only: no new programs, no recompiles.
+        Returns a summary dict (``handed_off`` / ``completed`` /
+        ``rejected_queue`` / ``cancelled_queue`` / ``flushed_chunks``).
+        """
+        now = self.clock()
+        self._draining = True
+        summary = {"handed_off": 0, "completed": 0, "rejected_queue": 0,
+                   "cancelled_queue": 0, "flushed_chunks": 0}
+        # queue: deadline-expired requests shed exactly as step() would
+        for req in self.scheduler.expire(now):
+            req.state = CANCELLED
+            self.metrics.on_retire(req.rid, now, CANCELLED)
+            summary["cancelled_queue"] += 1
+        queued = self.scheduler.drain()
+        if handoff is None:
+            for req in queued:
+                req.state = REJECTED
+                self.metrics.on_reject(req.rid, now)
+                self.metrics.on_retire(req.rid, now, REJECTED)
+                summary["rejected_queue"] += 1
+            residents = {r.rid: r for r in self._running.values()}
+            if self._admitting is not None:
+                residents[self._admitting.rid] = self._admitting
+            for _ in range(max_steps):
+                if not self.step():
+                    break
+            else:
+                raise RuntimeError(
+                    f"drain still busy after {max_steps} steps")
+            summary["completed"] = sum(
+                1 for r in residents.values() if r.state == COMPLETED)
+        else:
+            residents = sorted(self._running.values(),
+                               key=lambda r: r.slot)
+            if self._admitting is not None:
+                residents = sorted(residents + [self._admitting],
+                                   key=lambda r: r.slot)
+            for req in residents + queued:
+                # _retire flushes the written chunks (self._draining is
+                # set) and releases the slot; reset_for_resume returns
+                # the request to QUEUED with its tokens intact
+                self._retire(req, FAILOVER, now)
+                self.metrics.on_failover(req.rid, now)
+                req.reset_for_resume()
+                handoff(req)
+                summary["handed_off"] += 1
+        summary["flushed_chunks"] = self._drain_flushed
+        return summary
 
     def _build_resident(self) -> Dict[str, tuple]:
         """The engine's resident data-plane executables, fixed at build
@@ -704,6 +812,18 @@ class ServingEngine:
                 for name, (fn, args, static) in self._resident.items()}
 
     # -- internals ----------------------------------------------------- #
+    @staticmethod
+    def _context(req: Request) -> np.ndarray:
+        """The request's full prefill context: the prompt, plus any
+        tokens already emitted on a previous replica (failover resume).
+        The decode step then consumes context[-1] and continues the
+        per-request rng fold chain at ``len(tokens)`` — bit-equal to
+        never having moved."""
+        if not req.tokens:
+            return req.prompt
+        return np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+
     def _restore_prefix(self, req: Request) -> int:
         """Admission-time prefix reuse: chain-hash the prompt's full
         chunks and device-copy the longest cached run into the slot
@@ -714,7 +834,7 @@ class ServingEngine:
         budget (they replace the model forward, not ride next to it)."""
         if self.pool.prefix is None:
             return 0
-        keys = self.pool.prefix.chunk_keys(req.prompt)
+        keys = self.pool.prefix.chunk_keys(self._context(req))
         req._prefix_keys = keys
         if not keys:
             return 0
@@ -738,10 +858,11 @@ class ServingEngine:
         # split the one-shot path computes inside one big call)
         c = self.prefill_chunk
         pos = req._prefill_pos
-        n_prefill = req.prompt.size - 1
+        ctx = self._context(req)
+        n_prefill = ctx.size - 1
         valid = min(c, n_prefill - pos)
         chunk = np.zeros((1, c), np.int32)
-        chunk[0, :valid] = req.prompt[pos:pos + valid]
+        chunk[0, :valid] = ctx[pos:pos + valid]
         chunk = jnp.asarray(chunk)
         self.pool.cache = _prefill_chunk_prog(
             self._params, self.pool.cache, jnp.int32(req.slot),
@@ -858,13 +979,42 @@ class ServingEngine:
             return True
         return False
 
+    def _flush_resident(self, req: Request) -> int:
+        """Flush a resident request's WRITTEN full K/V chunks into the
+        shared prefix cache — the drain migration path: a request
+        completing or handing off mid-drain leaves its context behind so
+        the replica inheriting the conversation restores instead of
+        recomputing.  Only positions actually written are eligible: a
+        PREFILL resident has written ``_prefill_pos``; a DECODE one has
+        written ``context − 1`` positions (the final token's K/V lands
+        with its NEXT decode step, which will not run here)."""
+        if self.pool.prefix is None or req.slot is None:
+            return 0
+        c = self.prefill_chunk
+        ctx = self._context(req)
+        keys = self.pool.prefix.chunk_keys(ctx)
+        written = (req._prefill_pos if req.state == PREFILL
+                   else ctx.size - 1)
+        flushed = 0
+        for i in range(min(len(keys), written // c)):
+            if keys[i] not in self.pool.prefix:
+                self.pool.stash_chunk(req.slot, keys[i], i * c)
+                flushed += 1
+            if (self._draft_pool is not None
+                    and keys[i] not in self._draft_pool.prefix):
+                self._draft_pool.stash_chunk(req.slot, keys[i], i * c)
+        return flushed
+
     def _retire(self, req: Request, outcome: str, now: float) -> None:
         if req is self._admitting:
             self._admitting = None
-        self._running.pop(req.slot, None)
-        self.pool.free(req.slot)
-        if self._draft_pool is not None:
-            self._draft_pool.free(req.slot)
-        req.slot = None
+        if self._draining and outcome in (COMPLETED, FAILOVER):
+            self._drain_flushed += self._flush_resident(req)
+        if req.slot is not None:
+            self._running.pop(req.slot, None)
+            self.pool.free(req.slot)
+            if self._draft_pool is not None:
+                self._draft_pool.free(req.slot)
+            req.slot = None
         req.state = outcome
         self.metrics.on_retire(req.rid, now, outcome)

@@ -38,10 +38,12 @@ route identically (property-tested).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+import time
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from bluefog_tpu import config as bfconfig
 from bluefog_tpu.observe.fleet import FleetAggregator
 from bluefog_tpu.serving.scheduler import RequestRejected
 
@@ -58,10 +60,15 @@ def collect_serving_signals(registry) -> Dict[str, float]:
     ``bf_serving_queue_depth`` gauges the engine sets every step and the
     ``bf_serving_ttft_seconds`` windowed-histogram p50.  Zeros where the
     engine has not published yet — a fresh replica looks maximally
-    attractive, which is the right cold-start bias."""
+    attractive, which is the right cold-start bias.  ``last_step_ts``
+    is the replica's liveness heartbeat (``bf_serving_last_step_ts``,
+    engine-clock seconds; -1.0 before the first step) — the staleness
+    guard's input, so the router never scores a replica on gauges it
+    stopped updating."""
     occupancy = 0.0
     queue_depth = 0.0
     ttft_p50 = 0.0
+    last_step_ts = -1.0
     for name, kind, _help, _labels, m in registry.collect():
         if name == "bf_serving_slot_occupancy" and kind == "gauge":
             occupancy = float(m.value)
@@ -69,16 +76,23 @@ def collect_serving_signals(registry) -> Dict[str, float]:
             queue_depth = float(m.value)
         elif name == "bf_serving_ttft_seconds" and kind == "histogram":
             ttft_p50 = float(m.percentile(50))
+        elif name == "bf_serving_last_step_ts" and kind == "gauge":
+            last_step_ts = float(m.value)
     return {"occupancy": occupancy, "queue_depth": queue_depth,
-            "ttft_p50": ttft_p50}
+            "ttft_p50": ttft_p50, "last_step_ts": last_step_ts}
 
 
 class FleetSaturated(RequestRejected):
-    """Every replica refused the request.  ``queue_depths[i]`` is the
-    depth replica *i* reported in its own rejection — the fleet-wide
-    backpressure picture, for clients that scale their backoff."""
+    """Every live replica refused the request.  ``queue_depths[i]`` is
+    the depth each rejecting replica reported — the fleet-wide
+    backpressure picture, for clients that scale their backoff.
+    ``causes`` keeps the walk's evidence: ``(replica_index, exception)``
+    per refusal, across every retry attempt — an operator debugging a
+    saturation event sees WHICH replica said WHAT instead of a bare
+    count."""
 
-    def __init__(self, queue_depths: Sequence[int], max_queue: int):
+    def __init__(self, queue_depths: Sequence[int], max_queue: int,
+                 causes: Optional[Sequence] = None):
         depths = [int(d) for d in queue_depths]
         super().__init__(
             f"all {len(depths)} replicas at capacity "
@@ -86,6 +100,7 @@ class FleetSaturated(RequestRejected):
             queue_depth=max(depths) if depths else 0,
             max_queue=max_queue)
         self.queue_depths = depths
+        self.causes = list(causes or [])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,17 +110,25 @@ class RouterSnapshot:
     ``scores`` the router's ranking key (lower routes first), ``order``
     the resulting replica preference, and ``rounds``/``spread`` the
     gossip's convergence record (0/0.0 for a single replica, which
-    bypasses gossip entirely)."""
+    bypasses gossip entirely).  ``ages[i]`` is seconds since replica
+    *i* last published a step heartbeat (-1.0 if it never has);
+    ``suspect[i]`` is the staleness verdict — age beyond
+    ``BLUEFOG_REPLICA_STALE_S`` — that excised the replica from this
+    snapshot's scoring."""
 
     signals: np.ndarray
     scores: np.ndarray
     order: tuple
     rounds: int
     spread: float
+    ages: tuple = ()
+    suspect: tuple = ()
 
     def as_dict(self) -> Dict[str, List[float]]:
-        return {name: [float(v) for v in self.signals[:, m]]
-                for m, name in enumerate(SIGNAL_NAMES)}
+        out = {name: [float(v) for v in self.signals[:, m]]
+               for m, name in enumerate(SIGNAL_NAMES)}
+        out["ages"] = [float(a) for a in self.ages]
+        return out
 
 
 class FleetRouter:
@@ -133,13 +156,44 @@ class FleetRouter:
         Queue depth dominates by default: a queued request waits a full
         drain, occupancy only predicts the NEXT rejection, and TTFT is
         a tiebreaker-grade signal (normalized by the fleet max).
+      stale_after: staleness window in seconds (default
+        ``BLUEFOG_REPLICA_STALE_S``; 0 disables).  A replica whose last
+        step heartbeat is older than this is *suspect*: its gossip row
+        is masked and its score pinned to +inf, exactly the dead-mask
+        path — and it is re-admitted the moment it steps again.
+        Replicas that have NEVER stepped are exempt (cold replicas must
+        stay routable).
+      retries: extra full-fleet submit walks after the first exhausts
+        every live replica (default ``BLUEFOG_ROUTER_RETRIES`` = 0, the
+        historical single-walk behavior), separated by seeded
+        exponential backoff and a fresh poll.
+      retry_base_s: backoff base delay (default
+        ``BLUEFOG_ROUTER_RETRY_BASE_S``).
+      cooldown_s: after ``cooldown_after`` consecutive rejections from
+        one replica, demote it to the BACK of the walk for this long
+        (default ``BLUEFOG_ROUTER_COOLDOWN_S`` = 0, off).  Cooldown
+        only re-orders — a cooling replica is still tried last, so it
+        can never manufacture a ``FleetSaturated`` by itself.
+      seed: backoff determinism seed (delays derive from
+        ``(seed, request.rid, attempt)``).
+      clock: staleness/cooldown clock.  Defaults to the first engine's
+        injected clock, so virtual-time fleets age virtually.
+      sleep: backoff sleep callable (default ``time.sleep``; the
+        virtual-time bench passes its clock's advance).
     """
 
     def __init__(self, engines: Sequence, *,
                  registries: Optional[Sequence] = None,
                  schedule=None, rank: int = 0,
                  tol: float = 1e-13, registry=None,
-                 weights: Sequence[float] = (1.0, 4.0, 0.5)):
+                 weights: Sequence[float] = (1.0, 4.0, 0.5),
+                 stale_after: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 retry_base_s: Optional[float] = None,
+                 cooldown_s: Optional[float] = None,
+                 cooldown_after: int = 3, seed: int = 0,
+                 clock: Optional[Callable[[], float]] = None,
+                 sleep: Optional[Callable[[float], None]] = None):
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
         self.engines = list(engines)
@@ -178,23 +232,60 @@ class FleetRouter:
                     f"gossip schedule of size {self._agg.size} against "
                     f"{n} replicas")
         self._registry = registry
+        self.stale_after = float(bfconfig.replica_stale_s()
+                                 if stale_after is None else stale_after)
+        self.retries = int(bfconfig.router_retries()
+                           if retries is None else retries)
+        self.retry_base_s = float(bfconfig.router_retry_base_s()
+                                  if retry_base_s is None
+                                  else retry_base_s)
+        self.cooldown_s = float(bfconfig.router_cooldown_s()
+                                if cooldown_s is None else cooldown_s)
+        self.cooldown_after = int(cooldown_after)
+        self.seed = int(seed)
+        self._clock = (clock if clock is not None
+                       else getattr(self.engines[0], "clock",
+                                    time.monotonic))
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._fail_count = [0] * n
+        self._cooldown_until = [float("-inf")] * n
         self.n_routed = 0
         self.n_saturated = 0
 
     # -- gossip --------------------------------------------------------- #
-    def _local_signals(self) -> np.ndarray:
+    def _scrape(self):
         rows = [collect_serving_signals(r) for r in self.registries]
-        return np.array([[row[name] for name in SIGNAL_NAMES]
-                         for row in rows], np.float64)
+        local = np.array([[row[name] for name in SIGNAL_NAMES]
+                          for row in rows], np.float64)
+        heartbeats = np.array([row["last_step_ts"] for row in rows],
+                              np.float64)
+        return local, heartbeats
 
-    def poll(self, dead_mask=None) -> RouterSnapshot:
+    def _local_signals(self) -> np.ndarray:
+        return self._scrape()[0]
+
+    def poll(self, dead_mask=None,
+             now: Optional[float] = None) -> RouterSnapshot:
         """Scrape every replica's local gauges, gossip them through the
         one-hot block layout, and rank replicas from rank ``rank``'s
         converged view.  ``dead_mask`` excises replicas exactly the way
         the training-side gossip excises dead ranks — their signals
-        vanish and their scores come back ``+inf`` (never routed to)."""
+        vanish and their scores come back ``+inf`` (never routed to).
+        The staleness guard feeds the same path implicitly: a replica
+        whose step heartbeat is older than ``stale_after`` is excised
+        like a dead one (and re-admitted once it steps again)."""
         n, k = len(self.engines), len(SIGNAL_NAMES)
-        local = self._local_signals()
+        local, heartbeats = self._scrape()
+        now = self._clock() if now is None else now
+        ages = np.where(heartbeats >= 0.0, now - heartbeats, -1.0)
+        suspect = np.zeros(n, bool)
+        if self.stale_after > 0:
+            # never-published replicas (heartbeat -1) stay routable:
+            # cold replicas must look attractive, not dead
+            suspect = (heartbeats >= 0.0) & (ages > self.stale_after)
+        dead = (np.zeros(n, bool) if dead_mask is None
+                else np.asarray(dead_mask, bool).reshape(-1))
+        excised = dead | suspect
         if self._agg is None:
             signals = local
             rounds, spread = 0, 0.0
@@ -205,19 +296,20 @@ class FleetRouter:
             x = np.zeros((n, n * k))
             for i in range(n):
                 x[i, i * k:(i + 1) * k] = local[i]
-            agg = self._agg.aggregate(x, dead_mask=dead_mask)
+            agg = self._agg.aggregate(
+                x, dead_mask=excised if excised.any() else dead_mask)
             n_live = int((~np.isnan(agg.per_rank[:, 0])).sum())
             view = agg.per_rank[self.rank] * n_live
             signals = view.reshape(n, k)
             rounds, spread = agg.rounds, agg.spread
-        dead = (np.zeros(n, bool) if dead_mask is None
-                else np.asarray(dead_mask, bool).reshape(-1))
         scores = self._score(signals)
-        scores = np.where(dead, np.inf, scores)
+        scores = np.where(excised, np.inf, scores)
         order = tuple(int(i) for i in np.lexsort(
             (np.arange(n), scores)))  # score, then index — deterministic
         return RouterSnapshot(signals=signals, scores=scores,
-                              order=order, rounds=rounds, spread=spread)
+                              order=order, rounds=rounds, spread=spread,
+                              ages=tuple(float(a) for a in ages),
+                              suspect=tuple(bool(s) for s in suspect))
 
     def _score(self, signals: np.ndarray) -> np.ndarray:
         occ, depth, ttft = (signals[:, 0], signals[:, 1], signals[:, 2])
@@ -233,28 +325,62 @@ class FleetRouter:
         snap = snapshot if snapshot is not None else self.poll()
         return snap.order[0]
 
+    def _walk(self, snap: RouterSnapshot, now: float) -> List[int]:
+        """The submit candidate list: live (finite-score) replicas in
+        preference order, with replicas inside a rejection cooldown
+        demoted to the back — still tried, just last, so cooldown alone
+        can never manufacture a :class:`FleetSaturated`."""
+        live = [i for i in snap.order if np.isfinite(snap.scores[i])]
+        if self.cooldown_s <= 0:
+            return live
+        hot = [i for i in live if self._cooldown_until[i] <= now]
+        cooling = [i for i in live if self._cooldown_until[i] > now]
+        return hot + cooling
+
     def submit(self, request,
-               snapshot: Optional[RouterSnapshot] = None):
+               snapshot: Optional[RouterSnapshot] = None,
+               dead_mask=None):
         """Submit ``request`` to the best replica, falling through the
         preference order on per-replica :class:`RequestRejected`
-        backpressure.  Returns ``(replica_index, request)``; raises
-        :class:`FleetSaturated` when the whole fleet refuses."""
-        snap = snapshot if snapshot is not None else self.poll()
+        backpressure.  With ``retries`` > 0, a walk that exhausts every
+        live replica sleeps one seeded-backoff delay, re-polls, and
+        walks again — transient rejection windows (GC pauses, admission
+        bursts) are absorbed instead of surfaced.  Returns
+        ``(replica_index, request)``; raises :class:`FleetSaturated`
+        (with per-replica ``causes``) only after every attempt's walk
+        exhausted the live fleet."""
+        snap = snapshot if snapshot is not None else self.poll(
+            dead_mask=dead_mask)
         depths: List[int] = []
+        causes: List[tuple] = []
         max_queue = 0
-        for i in snap.order:
-            if not np.isfinite(snap.scores[i]):
-                continue
-            try:
-                self.engines[i].submit(request)
-            except RequestRejected as e:
-                depths.append(e.queue_depth)
-                max_queue = max(max_queue, e.max_queue)
-                continue
-            self.n_routed += 1
-            return i, request
+        for attempt in range(self.retries + 1):
+            if attempt > 0:
+                from bluefog_tpu.serving.resilience import backoff_sleep
+
+                backoff_sleep(attempt - 1, base=self.retry_base_s,
+                              seed=self.seed,
+                              salt=int(getattr(request, "rid", 0)),
+                              sleep=self._sleep)
+                snap = self.poll(dead_mask=dead_mask)
+            now = self._clock()
+            for i in self._walk(snap, now):
+                try:
+                    self.engines[i].submit(request)
+                except RequestRejected as e:
+                    depths.append(e.queue_depth)
+                    max_queue = max(max_queue, e.max_queue)
+                    causes.append((i, e))
+                    self._fail_count[i] += 1
+                    if (self.cooldown_s > 0 and self._fail_count[i]
+                            >= self.cooldown_after):
+                        self._cooldown_until[i] = now + self.cooldown_s
+                    continue
+                self._fail_count[i] = 0
+                self.n_routed += 1
+                return i, request
         self.n_saturated += 1
-        raise FleetSaturated(depths, max_queue)
+        raise FleetSaturated(depths, max_queue, causes=causes)
 
     # -- observability -------------------------------------------------- #
     def publish(self, snapshot: Optional[RouterSnapshot] = None
@@ -278,6 +404,12 @@ class FleetRouter:
                         reg.gauge(f"bf_fleet_serving_{name}",
                                   "gossiped replica serving signal",
                                   replica=str(i)).set(float(v))
+            for i in range(len(self.engines)):
+                s = (snap.suspect[i] if i < len(snap.suspect) else False)
+                reg.gauge("bf_replica_suspect",
+                          "1 while the staleness guard excises the "
+                          "replica", replica=str(i)).set(1.0 if s
+                                                         else 0.0)
             reg.gauge("bf_fleet_serving_best_replica",
                       "router's current first choice").set(snap.order[0])
             reg.counter("bf_fleet_serving_routed_total",

@@ -76,6 +76,8 @@ class ServingMetrics:
         self._occupancy: List[float] = []
         self._queue_depth: List[int] = []
         self.n_rejected = 0
+        self.n_failovers = 0
+        self.last_step_ts: Optional[float] = None
         self._registry = registry
         # prefix-cache / prefill accounting
         self.n_prefill_chunks = 0
@@ -168,6 +170,20 @@ class ServingMetrics:
             reg.histogram("bf_serving_latency_seconds",
                           "submit -> retire").observe(now - rec.submit_t)
 
+    def on_failover(self, rid, now: float):
+        """``rid`` was handed off to another replica (replica death or
+        graceful drain) — it retired HERE with outcome ``failover`` and
+        resumes elsewhere with its tokens intact."""
+        self.n_failovers += 1
+        rec = self._req.get(rid)
+        tr = rec.tracer if rec is not None else None
+        if tr is not None:
+            tr.instant(f"request.{rid}.failover")
+        reg = self._reg()
+        if reg is not None:
+            reg.counter("bf_serving_failovers_total",
+                        "requests handed off to another replica").inc()
+
     def on_prefill_chunk(self):
         """One cold prefill chunk ran (a model forward over one chunk).
         Together with :meth:`on_prefix_restore` this splits prompt
@@ -219,9 +235,16 @@ class ServingMetrics:
                           ).set(n_emitted / n_active)
 
     def on_step(self, occupancy: float, queue_depth: int,
-                step_seconds: Optional[float] = None):
+                step_seconds: Optional[float] = None,
+                now: Optional[float] = None):
         self._occupancy.append(occupancy)
         self._queue_depth.append(queue_depth)
+        if now is not None:
+            # the replica's liveness heartbeat (engine-clock seconds):
+            # the fleet router's staleness guard compares this against
+            # its own clock — a replica that stops stepping stops
+            # advancing it and goes suspect after BLUEFOG_REPLICA_STALE_S
+            self.last_step_ts = now
         reg = self._reg()
         if reg is not None:
             reg.counter("bf_serving_steps_total", "engine steps").inc()
@@ -229,6 +252,9 @@ class ServingMetrics:
                       "active slots / capacity, last step").set(occupancy)
             reg.gauge("bf_serving_queue_depth",
                       "queued requests, last step").set(queue_depth)
+            if now is not None:
+                reg.gauge("bf_serving_last_step_ts",
+                          "engine-clock time of the last step").set(now)
             if step_seconds is not None:
                 # the engine's measured step wall time, in the SAME
                 # histogram family the train loop reports into — the
@@ -271,6 +297,7 @@ class ServingMetrics:
             "n_requests": len(recs),
             "n_finished": len(finished),
             "n_rejected": self.n_rejected,
+            "n_failovers": self.n_failovers,
             "outcomes": outcomes,
             "tokens_generated": tokens,
             "tokens_per_sec": (tokens / window) if window else 0.0,

@@ -207,6 +207,12 @@ class PrefixCache:
     def __len__(self) -> int:
         return len(self._store)
 
+    def __contains__(self, key: str) -> bool:
+        """Membership WITHOUT touching LRU order or hit/miss counters —
+        the drain flush asks "already cached?" before paying a device
+        extract; that probe must not distort the reuse statistics."""
+        return key in self._store
+
     def stats(self) -> Dict[str, float]:
         total = self.hits + self.misses
         return {

@@ -77,6 +77,15 @@ class FifoScheduler:
             self._queue.remove(r)
         return expired
 
+    def drain(self) -> List:
+        """Remove and return EVERY queued request, deadline-expired ones
+        included — unlike :meth:`admit`, which silently sheds expired
+        entries, drain/failover must see them all so each gets a
+        terminal outcome (handed off, rejected, or expired)."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out
+
     def admit(self, now: float) -> Optional[object]:
         """Pop the next admissible request (FIFO after shedding expired
         ones), or ``None`` when the queue is empty.  The caller admits

@@ -74,6 +74,9 @@ MODULES = [
      "FIFO admission, deadlines, backpressure"),
     ("bluefog_tpu.serving.metrics",
      "serving metrics (TTFT, tokens/s) + request timeline spans"),
+    ("bluefog_tpu.serving.resilience",
+     "serving chaos: replica faults, token-exact failover, seeded "
+     "backoff"),
     ("bluefog_tpu.observe",
      "unified observability: metrics, spans, step profiles, exporters"),
     ("bluefog_tpu.observe.registry",

@@ -360,6 +360,41 @@ def test_rule_unregistered_marker(tmp_path):
     assert "gpu_only" in fs[0].message
 
 
+def test_rule_sleep_without_backoff(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import time
+        def retry_submit(engine, req):
+            for attempt in range(3):
+                time.sleep(0.1 * attempt)
+        def poll(engine):
+            while not engine.idle():
+                time.sleep(0.05)
+        """, in_serving=True, **_PKG)
+    assert [f.rule for f in fs] == ["sleep-without-backoff"] * 2
+    assert sorted(f.symbol for f in fs) == ["poll", "retry_submit"]
+    assert "backoff_sleep" in fs[0].message
+    # the seeded helper, injected sleeps, and one-shot sleeps are fine
+    assert _lint_src(tmp_path, """
+        import time
+        from bluefog_tpu.serving.resilience import backoff_sleep
+        def retry_submit(self, req):
+            for attempt in range(3):
+                backoff_sleep(attempt, base=0.05, seed=0, salt=req.rid)
+        def stall(self, seconds):
+            while self.waiting():
+                self._sleep(seconds)
+        def settle():
+            time.sleep(0.2)
+        """, in_serving=True, **_PKG) == []
+    # outside the serving tree the rule stays dormant
+    assert _lint_src(tmp_path, """
+        import time
+        def spin():
+            while True:
+                time.sleep(1.0)
+        """, in_serving=False, **_PKG) == []
+
+
 def test_registered_markers_include_analysis():
     marks = L.registered_markers(_REPO)
     assert "analysis" in marks and "perf" in marks

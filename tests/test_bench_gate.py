@@ -248,3 +248,63 @@ def test_gate_catches_rejoin_regression(capsys):
     bad = {r["name"] for r in rows if r["regressed"]}
     assert "rejoin.post_rejoin_floor" in bad
     assert "rejoin.throughput_recovery" in bad
+
+
+# --------------------------------------------------------------------- #
+# chaos-serving baseline (ISSUE 14): replica death, token-exact
+# failover, and drain join the gate flow — lost_requests is a gated
+# lower-is-better headline with ZERO tolerance, so even one request
+# silently dropped by a future failover change fails the compare
+# --------------------------------------------------------------------- #
+def test_chaos_serving_defaults_and_baseline():
+    """chaos_serving.py gates against the committed r15 artifact by
+    default; ``--compare ''`` opts out; the committed record passed
+    every machine-checked claim: zero lost requests, bit-exact
+    failover, bounded TTFT degradation, (N-1)/N throughput recovery,
+    and zero recompiles under every fault pattern."""
+    cs = _load_bench_module("chaos_serving")
+    args = cs.parse_args([])
+    assert args.compare == cs.DEFAULT_BASELINE
+    assert os.path.exists(args.compare)
+    assert cs.parse_args(["--compare", ""]).compare is None
+    assert cs.parse_args(["--compare", "x.json"]).compare == "x.json"
+    base = _load(os.path.join("benchmarks", "chaos_serving_r15.json"))
+    assert all(base["machine_checked"].values())
+    assert base["recompiles"] == 0
+    chaos = base["chaos_serving"]
+    assert chaos["lost_requests"] == 0
+    assert chaos["bitwise_exact"] and chaos["suspect_detected"]
+    assert chaos["failovers"] > 0
+    assert (chaos["throughput_recovery"]
+            >= base["config"]["recovery_floor"])
+    assert base["drain"]["lost_requests"] == 0
+    assert base["drain"]["flushed_chunks"] > 0
+    from bluefog_tpu.benchutil import bench_headline
+
+    head = bench_headline(base)
+    assert "chaos_serving.lost_requests" in head
+    assert "chaos_serving.throughput_recovery" in head
+    assert "fault_free.ttft_p99" in head
+    assert "drain.lost_requests" in head
+
+
+def test_gate_catches_lost_request_regression(capsys):
+    """A failover change that strands even ONE request fails the gate
+    at zero tolerance (lower-is-better, 0 -> 1 is an infinite relative
+    regression), as does a collapsed recovery ratio."""
+    from bluefog_tpu.benchutil import bench_compare
+
+    base = _load(os.path.join("benchmarks", "chaos_serving_r15.json"))
+    regressed = copy.deepcopy(base)
+    regressed["chaos_serving"]["lost_requests"] = 1
+    regressed["chaos_serving"]["throughput_recovery"] = 0.2
+    ok, rows = bench_compare(regressed, base, tolerance=0.25,
+                             tolerances={
+                                 "chaos_serving.lost_requests": 0.0})
+    assert ok is False
+    bad = {r["name"] for r in rows if r["regressed"]}
+    assert "chaos_serving.lost_requests" in bad
+    assert "chaos_serving.throughput_recovery" in bad
+    # ... and the committed record gates clean against itself
+    ok2, _ = bench_compare(base, base)
+    assert ok2 is True

@@ -402,11 +402,14 @@ def test_collect_serving_signals():
     eng = ServingEngine(variables, cfg, capacity=2, max_len=MAX_LEN,
                         prefill_chunk=4, registry=reg)
     sig = collect_serving_signals(reg)
-    assert sig == {"occupancy": 0.0, "queue_depth": 0.0, "ttft_p50": 0.0}
+    assert sig == {"occupancy": 0.0, "queue_depth": 0.0, "ttft_p50": 0.0,
+                   "last_step_ts": -1.0}  # -1: never stepped (the
+    # staleness guard exempts cold replicas)
     eng.submit(Request(np.arange(5, dtype=np.int32), 3))
     eng.run()
     sig = collect_serving_signals(reg)
     assert sig["ttft_p50"] >= 0.0  # histogram scraped without error
+    assert sig["last_step_ts"] >= 0.0  # heartbeat advanced by stepping
 
 
 def test_router_is_deterministic_and_prefers_idle():

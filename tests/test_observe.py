@@ -456,7 +456,8 @@ def test_train_step_publishes_and_opt_out(monkeypatch):
 def test_serving_metrics_publish_and_opt_out(monkeypatch):
     """ServingMetrics rides the registry (isolated here via registry=)
     and the summary dict keeps a pinned key set (the original shape plus
-    the fleet-serving prefix/speculative counters); with
+    the fleet-serving prefix/speculative counters and the failover
+    counter); with
     BLUEFOG_OBSERVE=0 and no explicit registry nothing is published."""
     from bluefog_tpu.serving.metrics import ServingMetrics
 
@@ -484,7 +485,8 @@ def test_serving_metrics_publish_and_opt_out(monkeypatch):
         "latency_p50", "latency_p99", "mean_slot_occupancy",
         "mean_queue_depth", "max_queue_depth", "prefill_chunks",
         "prefix_chunks_restored", "prefix_tokens_restored",
-        "prefix_hit_rate", "spec_steps", "accepted_per_step"}
+        "prefix_hit_rate", "spec_steps", "accepted_per_step",
+        "n_failovers"}
 
     monkeypatch.setenv("BLUEFOG_OBSERVE", "0")
     global_before = observe.get_registry().snapshot()
