@@ -26,6 +26,15 @@ and by scattered tests; the lint makes them mechanical:
     (marked ``_WEIGHT_AUTHORITY = True``).  Hand-rolled weight tables
     skip the row-stochastic normalization + shape contract of the
     shared helpers (``topology.spec`` / ``resilience.healing``).
+``weight-swap-outside-boundary``
+    In-place mutation of a live weight operand (``comm_weights[i] =
+    ...``, ``class_weights += ...``) outside the sanctioned
+    step-boundary swap helper (``topology.control.swap_comm_weights``)
+    and outside ``_WEIGHT_AUTHORITY`` modules.  The zero-recompile
+    contract delivers topology changes as whole replacement
+    ``(class_weights, self_weights)`` pairs at a step boundary;
+    element-wise edits of the live operands bypass the
+    healing/projection pipeline and can desynchronize ranks mid-step.
 ``unseeded-randomness``
     Legacy global-state ``np.random.*`` draws in ``benchmarks/``.
     Benchmark numbers must replay bit-identically; every script
@@ -103,6 +112,11 @@ WEIGHT_HELPERS = {
     "push_sum_weights", "grow_comm_weights", "row_stochastic",
     "neighbor_weights", "hierarchical_comm_weights",
 }
+
+# the one sanctioned seam for replacing live weight operands mid-run:
+# the step-boundary swap helper (topology.control).  Functions with
+# these names may touch weight tables element-wise.
+_SWAP_BOUNDARY_HELPERS = {"swap_comm_weights"}
 
 # raw ndarray constructors that build a table from scratch
 _RAW_CONSTRUCTORS = {
@@ -397,6 +411,61 @@ class _WeightBypassVisitor(_ScopeTracker):
 
 
 # --------------------------------------------------------------------- #
+# rule: weight-swap-outside-boundary
+# --------------------------------------------------------------------- #
+
+class _WeightSwapVisitor(_ScopeTracker):
+    """Element-wise mutation of a live weight operand outside the
+    sanctioned step-boundary swap helper.  Whole-name rebinding
+    (``comm_weights = healed_comm_weights(...)``) is the delivery
+    pattern and stays legal; ``comm_weights[0] = ...`` and
+    ``class_weights += ...`` are not — they edit the operand the
+    compiled step is already closed over, skipping projection/healing
+    and risking rank desync mid-step."""
+
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self.path = path
+        self.findings: List[Finding] = []
+
+    def _in_boundary(self) -> bool:
+        return any(s in _SWAP_BOUNDARY_HELPERS for s in self.scope)
+
+    def _weight_base(self, target: ast.expr) -> Optional[str]:
+        base = target.value if isinstance(target, ast.Subscript) \
+            else target
+        name = _last_attr(base)
+        if name and WEIGHT_NAME_RE.search(name):
+            return name
+        return None
+
+    def _flag(self, name: str, lineno: int) -> None:
+        self.findings.append(Finding(
+            "weight-swap-outside-boundary", self.path, lineno,
+            self.symbol,
+            f"'{name}' mutated element-wise outside the step-boundary "
+            "swap helper; live (class_weights, self_weights) operands "
+            "must be replaced wholesale via "
+            "topology.control.swap_comm_weights"))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if not self._in_boundary():
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    name = self._weight_base(t)
+                    if name:
+                        self._flag(name, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if not self._in_boundary():
+            name = self._weight_base(node.target)
+            if name:
+                self._flag(name, node.lineno)
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------- #
 # rule: unseeded-randomness (benchmarks/)
 # --------------------------------------------------------------------- #
 
@@ -539,6 +608,9 @@ def lint_file(path: str, rel: str, *, markers: Set[str],
             wv = _WeightBypassVisitor(rel)
             wv.visit(tree)
             findings += wv.findings
+            ws = _WeightSwapVisitor(rel)
+            ws.visit(tree)
+            findings += ws.findings
     if in_serving:
         sv = _SleepInLoopVisitor(rel)
         sv.visit(tree)

@@ -787,11 +787,12 @@ _HEADLINE_HIGHER = ("value", "mfu", "tokens_per_sec", "useful_tokens",
                     "speedup_tokens_per_sec", "vs_baseline",
                     "compiled_advantage", "hit_rate",
                     "accepted_per_step", "fleet_speedup",
-                    "throughput_recovery", "tp_overlap_fraction")
+                    "throughput_recovery", "tp_overlap_fraction",
+                    "cost_to_consensus_advantage")
 _HEADLINE_LOWER = ("ttft_p50", "ttft_p99", "latency_p50", "latency_p99",
                    "makespan_s", "p99", "p50", "cost_to_consensus",
                    "post_rejoin_floor", "dcn_bytes_per_step",
-                   "lost_requests")
+                   "lost_requests", "step_time_ratio")
 
 
 def bench_headline(record: dict) -> dict:
@@ -817,7 +818,8 @@ def bench_headline(record: dict) -> dict:
                     "rejoin", "pod_4x8", "pod_8x16", "fleet_one",
                     "fleet_two", "prefix", "speculative",
                     "hierarchical", "fault_free", "chaos_serving",
-                    "drain"):
+                    "drain", "adaptation", "congested", "shrink",
+                    "rollback"):
         if isinstance(record.get(section), dict):
             grab(record[section], section + ".")
     return out

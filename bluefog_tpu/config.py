@@ -36,6 +36,12 @@ __all__ = [
     "router_cooldown_s",
     "elastic_bootstrap_rounds",
     "elastic_quarantine_threshold",
+    "topology_replan_window",
+    "topology_replan_patience",
+    "topology_replan_degrade_ratio",
+    "topology_replan_margin",
+    "topology_replan_cooldown",
+    "topology_replan_probation",
     "coordinator",
     "num_processes",
     "process_id",
@@ -266,6 +272,82 @@ def elastic_quarantine_threshold() -> float:
         return float(_env("BLUEFOG_ELASTIC_QUARANTINE_THRESHOLD", "1.0"))
     except ValueError:
         return 1.0
+
+
+def topology_replan_window() -> int:
+    """BLUEFOG_TOPOLOGY_REPLAN_WINDOW (steps, default 8): how often the
+    topology control plane (:class:`bluefog_tpu.topology.control.
+    TopologyControlPlane`) takes a telemetry window — per-edge
+    byte/second DELTAS, straggler z snapshot, live-set — and re-scores
+    the incumbent schedule against it.  Larger windows smooth noise;
+    smaller ones react faster."""
+    try:
+        return max(1, int(_env("BLUEFOG_TOPOLOGY_REPLAN_WINDOW", "8")))
+    except ValueError:
+        return 8
+
+
+def topology_replan_patience() -> int:
+    """BLUEFOG_TOPOLOGY_REPLAN_PATIENCE (windows, default 2): consecutive
+    DEGRADED telemetry windows before the control plane triggers a
+    background re-synthesis — the debounce half of the hysteresis pair
+    (one noisy window never re-plans).  A live-set transition (death,
+    promotion) bypasses patience: membership is structural, not
+    noise."""
+    try:
+        return max(1, int(_env("BLUEFOG_TOPOLOGY_REPLAN_PATIENCE", "2")))
+    except ValueError:
+        return 2
+
+
+def topology_replan_degrade_ratio() -> float:
+    """BLUEFOG_TOPOLOGY_REPLAN_DEGRADE (default 1.3): a telemetry window
+    counts as degraded when some active edge's measured
+    seconds-per-activation (normalized by its nominal link cost)
+    exceeds the fleet-wide median by this factor — a RELATIVE test, so
+    uniform load (every link equally busy) never trips it and the units
+    of the seconds counters cancel out."""
+    try:
+        return float(_env("BLUEFOG_TOPOLOGY_REPLAN_DEGRADE", "1.3"))
+    except ValueError:
+        return 1.3
+
+
+def topology_replan_margin() -> float:
+    """BLUEFOG_TOPOLOGY_REPLAN_MARGIN (default 0.05): fractional
+    cost-to-consensus improvement a synthesized candidate must show
+    over the RE-SCORED incumbent to be accepted for a hot swap — the
+    anti-flap half of the hysteresis pair (a candidate that merely
+    ties the incumbent is noise, and swapping on noise would oscillate
+    between near-equal plans)."""
+    try:
+        return float(_env("BLUEFOG_TOPOLOGY_REPLAN_MARGIN", "0.05"))
+    except ValueError:
+        return 0.05
+
+
+def topology_replan_cooldown() -> int:
+    """BLUEFOG_TOPOLOGY_REPLAN_COOLDOWN (steps, default 16): minimum
+    steps between topology swaps (and after a rollback, before the
+    next trigger may fire).  Bounds the worst-case swap rate no matter
+    how noisy telemetry gets."""
+    try:
+        return max(0, int(_env("BLUEFOG_TOPOLOGY_REPLAN_COOLDOWN", "16")))
+    except ValueError:
+        return 16
+
+
+def topology_replan_probation() -> int:
+    """BLUEFOG_TOPOLOGY_REPLAN_PROBATION (steps, default 8): how long a
+    freshly swapped-in schedule is on probation — the control plane
+    watches the consensus-distance health signal and rolls back to the
+    incumbent if it worsens past the pre-swap baseline; after this
+    many clean steps the candidate is committed as the new
+    incumbent."""
+    try:
+        return max(1, int(_env("BLUEFOG_TOPOLOGY_REPLAN_PROBATION", "8")))
+    except ValueError:
+        return 8
 
 
 def fusion_threshold() -> int:

@@ -371,6 +371,30 @@ class MembershipController:
         joiner's anneal progress."""
         self._progress[self._code == _CODE[JOINING]] += 1
 
+    def reschedule(self, schedule) -> None:
+        """Swap the topology schedule under the SAME membership: the
+        topology control plane hot-swaps a re-planned schedule into a
+        running step, and the membership weights must re-render over
+        the new specs (re-plan from the pristine spec, then re-apply
+        the current masks).  Rank states and joiner progress are
+        untouched; the steady-weight cache is dropped — its entries
+        were rendered over the old specs and keying is by membership
+        pattern only."""
+        if isinstance(schedule, (Topology, DynamicTopology)):
+            schedule = [schedule]
+        if not schedule:
+            raise ValueError(
+                "MembershipController.reschedule needs a non-empty "
+                "schedule")
+        sizes = {s.size for s in schedule}
+        if sizes != {self.size}:
+            raise ValueError(
+                f"reschedule sizes {sizes} do not match world size "
+                f"{self.size} — membership cannot survive a world "
+                "resize")
+        self.schedule = tuple(schedule)
+        self._steady.clear()
+
     # ------------------------------------------------------------- #
     # traced-data renders
     # ------------------------------------------------------------- #

@@ -58,7 +58,9 @@ __all__ = [
     "edge_list",
     "gossip_edge_list",
     "record_edge_traffic",
+    "record_edge_timing",
     "traffic_snapshot",
+    "TrafficDeltas",
     "push_sum_matrix",
     "FleetAggregate",
     "FleetAggregator",
@@ -67,6 +69,7 @@ __all__ = [
 ]
 
 _EDGE_BYTES_HELP = "per-edge neighbor-exchange payload (logical bytes)"
+_EDGE_SECONDS_HELP = "per-edge exchange wall time (measured seconds)"
 
 # the aggregator's per-dead-mask matrix cache is LRU-bounded: elastic
 # membership churns the mask in BOTH directions (die -> heal -> rejoin
@@ -134,8 +137,31 @@ def record_edge_traffic(spec: CommSpec, payload_bytes: float,
                     src=src, dst=dst, **extra).inc(payload_bytes)
 
 
+def record_edge_timing(spec: CommSpec, seconds: float,
+                       registry=None, pairs=None,
+                       link: Optional[str] = None) -> None:
+    """Add ``seconds`` of measured exchange wall time to
+    ``bf_edge_seconds_total{src,dst}`` for every declared edge of
+    ``spec`` (or the explicit ``pairs``) — the TIMING twin of
+    :func:`record_edge_traffic`.  A congested link carries the same
+    bytes but more seconds, so the control plane prices links by the
+    seconds counters where they exist: byte volume alone cannot see a
+    link that got slow."""
+    reg = registry if registry is not None else (
+        _registry_mod.get_registry() if _registry_mod.enabled() else None)
+    if reg is None:
+        return
+    extra = {} if link is None else {"link": link}
+    for (src, dst) in (edge_list(spec) if pairs is None else pairs):
+        reg.counter("bf_edge_seconds_total", _EDGE_SECONDS_HELP,
+                    src=src, dst=dst, **extra).inc(seconds)
+
+
 def traffic_snapshot(registry=None,
-                     link: Optional[str] = None) -> Dict[tuple, float]:
+                     link: Optional[str] = None,
+                     since: Optional[Dict[tuple, float]] = None,
+                     metric: str = "bf_edge_bytes_total"
+                     ) -> Dict[tuple, float]:
     """The accumulated per-edge exchange traffic, read back OUT of the
     registry: ``{(src, dst): bytes}`` from every
     ``bf_edge_bytes_total{src,dst}`` counter — the feed the topology
@@ -149,14 +175,26 @@ def traffic_snapshot(registry=None,
     view); ``link="dcn"``/``link="ici"`` selects ONLY the counters
     tagged with that leg by a hierarchical recorder, which is what
     hierarchical ``PodSpec.from_telemetry`` calibration reads so cheap
-    intra-machine traffic never masquerades as DCN load."""
+    intra-machine traffic never masquerades as DCN load.
+
+    ``since`` turns the lifetime totals into a WINDOWED delta: pass a
+    previous snapshot (same ``registry``/``link``/``metric``) and only
+    the traffic accumulated after it comes back, edges that moved
+    nothing omitted.  Lifetime counters are monotonic, so a long-lived
+    fleet's history drowns any new hotspot — calibrating from totals
+    prices links by what they carried LAST WEEK; the delta prices what
+    they carry NOW (the stale-calibration fix, unit-tested in
+    tests/test_fleet.py).  :class:`TrafficDeltas` packages the marker
+    bookkeeping.  ``metric`` selects the counter family —
+    ``bf_edge_seconds_total`` reads the timing leg
+    (:func:`record_edge_timing`) through the same machinery."""
     reg = registry if registry is not None else (
         _registry_mod.get_registry() if _registry_mod.enabled() else None)
     if reg is None:
         return {}
     out: Dict[tuple, float] = {}
     for name, kind, _help, labels, m in reg.collect():
-        if name != "bf_edge_bytes_total" or kind != "counter":
+        if name != metric or kind != "counter":
             continue
         if link is not None and labels.get("link") != link:
             continue
@@ -165,7 +203,43 @@ def traffic_snapshot(registry=None,
         except (KeyError, ValueError):
             continue
         out[key] = out.get(key, 0.0) + float(m.value)
+    if since is not None:
+        out = {k: v - since.get(k, 0.0) for k, v in out.items()
+               if v - since.get(k, 0.0) > 0.0}
     return out
+
+
+class TrafficDeltas:
+    """Windowed per-edge traffic reader: every :meth:`take` returns
+    what moved SINCE the previous take and advances the marker — the
+    handle the topology control plane holds so each telemetry window
+    prices recent load, never lifetime monotonic totals.
+
+    Construction snapshots the current counters, so the first
+    :meth:`take` already excludes everything that happened before the
+    watcher existed (the stale-calibration case)."""
+
+    def __init__(self, registry=None, link: Optional[str] = None,
+                 metric: str = "bf_edge_bytes_total"):
+        self._registry = registry
+        self._link = link
+        self._metric = metric
+        self._mark = traffic_snapshot(registry, link=link, metric=metric)
+
+    def take(self) -> Dict[tuple, float]:
+        """Per-edge traffic since the previous take (or construction):
+        ``{(src, dst): amount}``, quiet edges omitted."""
+        cur = traffic_snapshot(self._registry, link=self._link,
+                               metric=self._metric)
+        out = {k: v - self._mark.get(k, 0.0) for k, v in cur.items()
+               if v - self._mark.get(k, 0.0) > 0.0}
+        self._mark = cur
+        return out
+
+    def peek(self) -> Dict[tuple, float]:
+        """The delta :meth:`take` would return, without advancing."""
+        return traffic_snapshot(self._registry, link=self._link,
+                                since=self._mark, metric=self._metric)
 
 
 def push_sum_matrix(spec: CommSpec, dead_mask=None) -> np.ndarray:
@@ -630,8 +704,15 @@ class StragglerDetector:
                 fg.set(1.0 if self._flagged[r] else 0.0)
         return [int(r) for r in np.nonzero(newly)[0]]
 
-    def z_scores(self) -> np.ndarray:
-        return self._z.copy()
+    def z_scores(self) -> Dict[int, float]:
+        """Rank -> robust z snapshot of the LAST observation — the
+        whole vector, not only threshold crossings, so the topology
+        control plane (and an operator dashboard) can read
+        sub-threshold drift before a rank is formally flagged.  A
+        recovered rank's next observation recomputes its z near zero,
+        so the snapshot clears with recovery (tested in
+        tests/test_fleet.py); all-zero before the first observation."""
+        return {int(r): float(z) for r, z in enumerate(self._z)}
 
     def flagged(self) -> List[int]:
         """Ranks currently flagged (clears when the streak breaks)."""

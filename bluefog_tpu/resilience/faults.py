@@ -25,11 +25,12 @@ from typing import Any, List, Sequence, Tuple
 import numpy as np
 
 __all__ = ["Fault", "FaultPlan", "NAN", "INF", "DEAD", "STALL", "PREEMPT",
-           "ServingFault", "ServingFaultPlan", "REPLICA_DEATH",
+           "CONGEST", "ServingFault", "ServingFaultPlan", "REPLICA_DEATH",
            "REPLICA_STALL", "SUBMIT_REJECT"]
 
 NAN, INF, DEAD, STALL, PREEMPT = "nan", "inf", "dead", "stall", "preempt"
-_KINDS = (NAN, INF, DEAD, STALL, PREEMPT)
+CONGEST = "congest"
+_KINDS = (NAN, INF, DEAD, STALL, PREEMPT, CONGEST)
 
 REPLICA_DEATH = "replica_death"
 REPLICA_STALL = "replica_stall"
@@ -51,13 +52,24 @@ class Fault:
     answer, not automatically live).  ``stall_seconds``: host-loop
     sleep injected PER ACTIVE STEP by a ``stall`` fault (exercises the
     watchdog / op timeout / straggler detector, not the numerics); a
-    multi-step stall on one rank is the injected-straggler scenario."""
+    multi-step stall on one rank is the injected-straggler scenario.
+
+    A ``congest`` fault degrades the directed LINK ``rank -> dst`` by
+    ``factor`` (time per byte, not correctness) for ``duration`` steps
+    — the fault class the topology control plane exists to route
+    around.  It corrupts nothing and stalls nothing by itself; a chaos
+    harness reads :meth:`FaultPlan.congested_links` each step and
+    charges the active schedule's use of the slowed link (virtual
+    per-edge seconds fed into ``bf_edge_seconds_total`` and the
+    per-rank step-time vector)."""
 
     step: int
     rank: int
     kind: str
     duration: int = 1
     stall_seconds: float = 0.0
+    dst: int = -1
+    factor: float = 1.0
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -68,6 +80,13 @@ class Fault:
         if self.duration < 1:
             raise ValueError(
                 f"fault duration must be >= 1, got {self.duration}")
+        if self.kind == CONGEST:
+            if self.dst < 0:
+                raise ValueError("a congest fault names a directed link "
+                                 "— dst must be a valid rank")
+            if self.factor < 1.0:
+                raise ValueError(f"congestion factor must be >= 1 "
+                                 f"(a slowdown), got {self.factor}")
 
 
 class FaultPlan:
@@ -89,6 +108,9 @@ class FaultPlan:
             if not 0 <= f.rank < size:
                 raise ValueError(
                     f"fault rank {f.rank} outside world of size {size}")
+            if f.kind == CONGEST and not 0 <= f.dst < size:
+                raise ValueError(
+                    f"congest dst {f.dst} outside world of size {size}")
         self.size = size
         self.faults: Tuple[Fault, ...] = tuple(
             sorted(faults, key=lambda f: (f.step, f.rank)))
@@ -131,6 +153,34 @@ class FaultPlan:
         of ``run_resilient(elastic=...)`` — the full preempt -> heal ->
         bootstrap -> rejoin cycle from one deterministic plan."""
         return FaultPlan(size, [Fault(step, rank, PREEMPT, duration)])
+
+    @staticmethod
+    def persistent_straggler(size: int, rank: int, step: int,
+                             stall_seconds: float,
+                             duration: int = 1_000_000) -> "FaultPlan":
+        """One rank runs ``stall_seconds`` slow from ``step`` ON — the
+        open-ended straggler that never recovers on its own (a bad
+        host, a thermally-throttled chip).  Where :meth:`straggler`
+        models a transient the detector merely names, a persistent
+        straggler is a standing degradation signal the topology
+        control plane must eventually re-plan around.  ``duration``
+        defaults far past any bench horizon."""
+        return FaultPlan(size, [Fault(step, rank, STALL, duration,
+                                      stall_seconds=stall_seconds)])
+
+    @staticmethod
+    def congest_link(size: int, src: int, dst: int, factor: float,
+                     start: int, duration: int) -> "FaultPlan":
+        """The directed link ``src -> dst`` carries bytes ``factor``x
+        slower for ``[start, start + duration)`` — an injected DCN
+        congestion event.  Purely a cost-model fault: nothing is
+        corrupted and the host loop is not stalled; the chaos harness
+        reads :meth:`congested_links` per step and charges whatever
+        the ACTIVE schedule ships across the slowed link, which is
+        exactly the signal (``bf_edge_seconds_total`` deltas) the
+        topology control plane re-plans from."""
+        return FaultPlan(size, [Fault(start, src, CONGEST, duration,
+                                      dst=dst, factor=factor)])
 
     def merged(self, other: "FaultPlan") -> "FaultPlan":
         if other.size != self.size:
@@ -197,6 +247,19 @@ class FaultPlan:
         for f in self.active(step):
             if f.kind == STALL:
                 out[f.rank] += f.stall_seconds
+        return out
+
+    def congested_links(self, step: int) -> dict:
+        """Directed links degraded at ``step``: ``{(src, dst):
+        factor}``, overlapping congestions multiplying.  The virtual
+        cost-model input of the adaptive-topology chaos bench: a
+        harness multiplies each active edge's nominal transfer time by
+        the link's factor before billing ``bf_edge_seconds_total``."""
+        out: dict = {}
+        for f in self.active(step):
+            if f.kind == CONGEST:
+                key = (f.rank, f.dst)
+                out[key] = out.get(key, 1.0) * f.factor
         return out
 
     def last_onset(self) -> int:

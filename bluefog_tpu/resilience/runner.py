@@ -49,7 +49,12 @@ class ResilienceEvent:
     """One entry of the run's event log: ``kind`` in {"checkpoint",
     "skip", "rank_dead", "rollback", "straggler",
     "bad_window_unattributed", "rank_joining", "rank_promoted",
-    "rank_join_failed"}; ``step`` is the step index the event fired at;
+    "rank_join_failed", "topology_trigger", "topology_reject",
+    "topology_swap", "topology_commit", "topology_rollback"} (the
+    ``topology_*`` kinds come from the topology control plane when the
+    run was started with ``control=``; their ``detail`` carries the
+    plane's reason/schedule/score fields);
+    ``step`` is the step index the event fired at;
     ``detail`` carries kind-specific fields (rollback:
     ``restored_step``, ``backoff``, ``dead``; straggler: ``ranks``,
     ``z``; the elastic kinds: ``rank``, plus ``disagreement``/``rounds``
@@ -99,6 +104,7 @@ def run_resilient(
     straggler=None,
     step_times_fn: Optional[Callable[[int, float], Any]] = None,
     elastic=None,
+    control=None,
 ) -> ResilientResult:
     """Train ``steps`` steps under faults; see the module docstring for
     the recovery semantics.
@@ -157,6 +163,20 @@ def run_resilient(
     re-offers it for a fresh quarantined bootstrap.  Requires
     ``schedule=``; while elastic is on, the controller owns
     ``comm_weights``.
+
+    ``control`` (a :class:`bluefog_tpu.topology.TopologyControlPlane`
+    built over this step's schedule as its carrier) closes the topology
+    loop: each step boundary the plane's ``on_step`` advances its
+    detect -> re-plan -> hot-swap state machine, its events are
+    re-emitted as ``topology_*`` resilience events, and after a swap or
+    a probation rollback the loop re-delivers weights from the plane's
+    ACTIVE schedule healed under the current dead mask (swap and heal
+    compose through the one ``swap_comm_weights`` boundary).  While
+    elastic is also on, a swap ``reschedule``-s the
+    ``MembershipController`` onto the new specs and the controller
+    keeps owning ``comm_weights``.  Requires ``schedule=``; flat steps
+    only (a hierarchical schedule is machine-level while the plane's
+    carrier projection is rank-level).
     """
     if not hasattr(train_step, "default_comm_weights"):
         raise ValueError(
@@ -181,15 +201,40 @@ def run_resilient(
     # detector stays RANK-level, and every heal delivery collapses the
     # rank mask through the machine failure domain
     hier_l = getattr(train_step, "hierarchical_local_size", None)
+    if control is not None:
+        if not schedule:
+            raise ValueError(
+                "run_resilient(control=...) needs schedule= — the "
+                "control plane is a weight re-plan over the step's "
+                "carrier specs")
+        if hier_l:
+            raise ValueError(
+                "run_resilient(control=...) does not drive a "
+                "hierarchical step: the plane projects RANK-level "
+                "candidates while a hierarchical schedule is "
+                "MACHINE-level — synthesize hierarchically offline or "
+                "train flat")
+        if len(control.carrier) != len(schedule):
+            raise ValueError(
+                f"control plane carrier has {len(control.carrier)} "
+                f"rounds but the step's schedule has {len(schedule)} — "
+                "build the plane over the schedule the step compiled")
 
     def heal(dead_mask):
+        # with a control plane, healing applies to the ACTIVE (possibly
+        # swapped) schedule, not the build-time one — a heal right
+        # after a hot swap must not silently revert the swap
+        if control is not None:
+            return control.healed_weights(dead_mask)
         if hier_l:
             return healed_hierarchical_comm_weights(
                 schedule, dead_mask, hier_l)
         return healed_comm_weights(schedule, dead_mask)
 
     dead = detector.dead_mask()
-    if dead.any() and schedule:
+    if schedule and (dead.any() or control is not None):
+        # the control plane's initial active plan may differ from the
+        # carrier's own weights (``initial=``) — deliver it up front
         comm_weights = heal(dead)
 
     controller = None
@@ -229,6 +274,10 @@ def run_resilient(
         admit_fn = elastic.admit
         if admit_fn is None and fault_plan is not None:
             admit_fn = fault_plan.rejoinable_ranks
+        if control is not None:
+            # the controller renders weights over the plane's ACTIVE
+            # plan (swap-aware) while keeping membership authority
+            controller.reschedule(control.active_schedule())
         comm_weights = controller.comm_weights()
 
     events: List[ResilienceEvent] = []
@@ -504,6 +553,23 @@ def run_resilient(
             if backoff > 0:
                 sleep(backoff)
             continue
+
+        if control is not None:
+            # step boundary: the plane may hand back a swap (accepted
+            # candidate), a probation verdict, or telemetry-window
+            # transitions — re-deliver weights whenever the active
+            # schedule changed hands
+            acts = control.on_step(step, dead_mask=detector.dead_mask(),
+                                   params=params)
+            for kind, detail in acts:
+                emit(kind, step, **detail)
+            if any(k in ("topology_swap", "topology_rollback")
+                   for k, _ in acts):
+                if controller is not None:
+                    controller.reschedule(control.active_schedule())
+                    comm_weights = controller.comm_weights()
+                else:
+                    comm_weights = heal(detector.dead_mask())
 
         if (force_ckpt or (checkpoint_every > 0
                            and step % checkpoint_every == 0)) \

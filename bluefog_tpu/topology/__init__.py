@@ -62,3 +62,7 @@ from bluefog_tpu.topology.compiler import (  # noqa: F401
     expand_machine_pairs,
     menu_schedules,
 )
+from bluefog_tpu.topology.control import (  # noqa: F401
+    TopologyControlPlane,
+    swap_comm_weights,
+)

@@ -40,6 +40,8 @@ MODULES = [
     ("bluefog_tpu.topology.torus", "physical ICI torus routing/congestion"),
     ("bluefog_tpu.topology.compiler",
      "topology compiler: pod cost model + schedule synthesis"),
+    ("bluefog_tpu.topology.control",
+     "closed-loop control plane: detect, re-plan, hot-swap"),
     ("bluefog_tpu.optim", "distributed optimizer wrappers (eager API)"),
     ("bluefog_tpu.optim.functional",
      "jitted whole-pytree train steps (SPMD API)"),

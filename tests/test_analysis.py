@@ -321,6 +321,42 @@ def test_rule_weight_bypass(tmp_path):
         """, **_PKG) == []
 
 
+def test_rule_weight_swap_boundary(tmp_path):
+    fs = _lint_src(tmp_path, """
+        def hotfix(comm_weights, delta):
+            comm_weights[0] = delta
+            obj.class_weights[1, :] = 0.0
+            self_weights += delta
+        """, **_PKG)
+    assert [f.rule for f in fs] == ["weight-swap-outside-boundary"] * 3
+    assert all(f.symbol == "hotfix" for f in fs)
+    # the sanctioned step-boundary helper may touch the tables
+    assert _lint_src(tmp_path, """
+        def swap_comm_weights(plane, dead_mask):
+            comm_weights[0] = plane.next_round()
+            return comm_weights
+        """, **_PKG) == []
+    # authority modules are exempt wholesale
+    assert _lint_src(tmp_path, """
+        _WEIGHT_AUTHORITY = True
+        def build(comm_weights):
+            comm_weights[0] = 1.0
+        """, **_PKG) == []
+    # whole-name rebinding is the delivery pattern, not a mutation
+    assert _lint_src(tmp_path, """
+        from bluefog_tpu.resilience.healing import healed_comm_weights
+        def deliver(specs, dead):
+            comm_weights = healed_comm_weights(specs, dead)
+            return comm_weights
+        """, **_PKG) == []
+    # unrelated names never match
+    assert _lint_src(tmp_path, """
+        def f(table):
+            table[0] = 1.0
+            table += 2.0
+        """, **_PKG) == []
+
+
 def test_weight_authority_modules_are_marked():
     """The five modules that legitimately build weight tables carry
     the authority marker (so the rule has a principled escape hatch,

@@ -308,3 +308,59 @@ def test_gate_catches_lost_request_regression(capsys):
     # ... and the committed record gates clean against itself
     ok2, _ = bench_compare(base, base)
     assert ok2 is True
+
+# --------------------------------------------------------------------- #
+# adaptive-topology baseline (ISSUE 15): the closed-loop control plane
+# joins the gate flow — step_time_ratio (lower-better) and
+# cost_to_consensus_advantage (higher-better) are gated headlines, so
+# a control-plane change that stops adapting (ratios collapse to 1.0)
+# fails the compare
+# --------------------------------------------------------------------- #
+def test_adaptive_topology_defaults_and_baseline():
+    """chaos_adaptive_topology.py gates against the committed r16
+    artifact by default; ``--compare ''`` opts out; the committed
+    record passed every machine-checked claim: trigger->swap->commit
+    under congestion AND shrink with zero recompiles, probation
+    rollback restoring the incumbent, and the straggler named."""
+    at = _load_bench_module("chaos_adaptive_topology")
+    args = at.parse_args([])
+    assert args.compare == at.DEFAULT_BASELINE
+    assert os.path.exists(args.compare)
+    assert at.parse_args(["--compare", ""]).compare is None
+    assert at.parse_args(["--compare", "x.json"]).compare == "x.json"
+    base = _load(os.path.join("benchmarks",
+                              "chaos_adaptive_topology_r16.json"))
+    assert all(base["checks"].values())
+    assert base["adaptation"]["step_time_ratio"] < 0.9
+    assert base["adaptation"]["cost_to_consensus_advantage"] > 1.05
+    assert base["congested"]["recompiles"] == 0
+    assert base["shrink"]["recompiles_adapted"] == 0
+    assert base["rollback"]["restored"] == "initial"
+    from bluefog_tpu.benchutil import bench_headline
+
+    head = bench_headline(base)
+    assert "adaptation.step_time_ratio" in head
+    assert "adaptation.cost_to_consensus_advantage" in head
+
+
+def test_gate_catches_no_adaptation_regression(capsys):
+    """A control plane that silently stops re-planning (post-swap step
+    time no better than the congested incumbent, cost-to-consensus
+    advantage gone) fails the gate on BOTH headline directions."""
+    from bluefog_tpu.benchutil import bench_compare
+
+    base = _load(os.path.join("benchmarks",
+                              "chaos_adaptive_topology_r16.json"))
+    regressed = copy.deepcopy(base)
+    regressed["adaptation"]["step_time_ratio"] = 1.0
+    regressed["adaptation"]["cost_to_consensus_advantage"] = 1.0
+    regressed["congested"]["step_time_ratio"] = 1.0
+    regressed["congested"]["cost_to_consensus_advantage"] = 1.0
+    ok, rows = bench_compare(regressed, base, tolerance=0.25)
+    assert ok is False
+    bad = {r["name"] for r in rows if r["regressed"]}
+    assert "adaptation.step_time_ratio" in bad
+    assert "adaptation.cost_to_consensus_advantage" in bad
+    # ... and the committed record gates clean against itself
+    ok2, _ = bench_compare(base, base)
+    assert ok2 is True

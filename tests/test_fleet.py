@@ -261,10 +261,15 @@ def test_straggler_detector_flags_within_patience_and_clears():
         if i < 2:
             assert det.flagged() == []  # not yet: patience=3
     assert newly == [5] and det.flagged() == [5]
-    assert det.z_scores()[5] > 4.0
-    # recovery clears the flag (and the streak)
+    z = det.z_scores()
+    assert set(z) == set(range(N)) and z[5] > 4.0
+    # sub-threshold drift is readable without any event having fired
+    assert all(abs(z[r]) < 4.0 for r in range(N) if r != 5)
+    # recovery clears the flag (and the streak) — and the z snapshot
+    # tracks the LATEST observation, so the recovered rank reads sane
     assert det.observe(base + rng.normal(0, 1e-4, N)) == []
     assert det.flagged() == []
+    assert det.z_scores()[5] < 4.0
 
 
 def test_straggler_detector_robust_to_its_own_outlier():
@@ -561,3 +566,58 @@ def test_train_step_records_edge_traffic():
     step2(params2, ostate2, batch2, jnp.int32(0))
     assert reg.counter("bf_edge_bytes_total", src=edges0[0][0],
                        dst=edges0[0][1]).value == mid
+
+
+# --------------------------------------------------------------------- #
+# windowed traffic deltas + timing twin (ISSUE 15: the control plane's
+# telemetry feed)
+# --------------------------------------------------------------------- #
+def test_record_edge_timing_bills_seconds_family():
+    reg = MetricsRegistry()
+    FL.record_edge_timing(None, 0.25, registry=reg, pairs=[(0, 1)])
+    FL.record_edge_timing(None, 0.75, registry=reg, pairs=[(0, 1), (2, 3)])
+    snap = FL.traffic_snapshot(reg, metric="bf_edge_seconds_total")
+    assert snap[(0, 1)] == pytest.approx(1.0)
+    assert snap[(2, 3)] == pytest.approx(0.75)
+    # the per-leg label keeps hierarchical legs separable, same as bytes
+    FL.record_edge_timing(None, 0.5, registry=reg, pairs=[(0, 2)],
+                          link="dcn")
+    assert FL.traffic_snapshot(
+        reg, link="dcn", metric="bf_edge_seconds_total") == {(0, 2): 0.5}
+    # and seconds never leak into the BYTES family the compiler reads
+    assert FL.traffic_snapshot(reg) == {}
+
+
+def test_traffic_deltas_window_semantics():
+    """take() returns what moved SINCE the previous take — never
+    lifetime totals — and construction snapshots the registry, so
+    pre-history is excluded from the first window.  peek() reads the
+    window without advancing it."""
+    reg = MetricsRegistry()
+    FL.record_edge_timing(None, 10.0, registry=reg, pairs=[(0, 1)])
+    deltas = FL.TrafficDeltas(reg, metric="bf_edge_seconds_total")
+    assert deltas.take() == {}  # the 10s of pre-history is not a delta
+    FL.record_edge_timing(None, 2.0, registry=reg, pairs=[(0, 1)])
+    FL.record_edge_timing(None, 3.0, registry=reg, pairs=[(4, 5)])
+    assert deltas.peek() == {(0, 1): 2.0, (4, 5): 3.0}
+    assert deltas.peek() == {(0, 1): 2.0, (4, 5): 3.0}  # no advance
+    assert deltas.take() == {(0, 1): 2.0, (4, 5): 3.0}
+    assert deltas.take() == {}  # quiet window: quiet edges omitted
+    FL.record_edge_timing(None, 1.5, registry=reg, pairs=[(0, 1)])
+    assert deltas.take() == {(0, 1): 1.5}
+
+
+def test_traffic_snapshot_since_subtracts_marker():
+    reg = MetricsRegistry()
+    FL.record_edge_traffic(None, registry=reg, pairs=[(0, 1)],
+                           payload_bytes=100)
+    mark = FL.traffic_snapshot(reg)
+    FL.record_edge_traffic(None, registry=reg, pairs=[(0, 1)],
+                           payload_bytes=40)
+    FL.record_edge_traffic(None, registry=reg, pairs=[(2, 3)],
+                           payload_bytes=7)
+    assert FL.traffic_snapshot(reg, since=mark) == {(0, 1): 40.0,
+                                                    (2, 3): 7.0}
+    # an edge with no NEW traffic is omitted, not reported as zero
+    assert (0, 1) not in FL.traffic_snapshot(
+        reg, since=FL.traffic_snapshot(reg))

@@ -114,6 +114,49 @@ def test_fault_plan_queries_and_determinism():
         R.FaultPlan(4, [R.Fault(0, 7, "nan")])
 
 
+def test_fault_plan_congestion_and_persistent_straggler():
+    """The ISSUE-15 fault kinds: a congested directed link is a pure
+    cost-model fault (nothing corrupted, nothing stalled), overlapping
+    congestions multiply, and a persistent straggler stalls its rank
+    from onset past any bench horizon."""
+    plan = R.FaultPlan.congest_link(N, 0, 2, 4.0, start=8, duration=10)
+    assert plan.congested_links(7) == {}
+    assert plan.congested_links(8) == {(0, 2): 4.0}
+    assert plan.congested_links(17) == {(0, 2): 4.0}
+    assert plan.congested_links(18) == {}
+    # nothing else is perturbed by a congest fault
+    np.testing.assert_array_equal(plan.corrupt_codes(8), np.zeros(N))
+    assert plan.stall_seconds(8) == 0.0
+    assert plan.dead_ranks(8) == []
+    # merged overlapping congestion on the SAME link multiplies
+    both = plan.merged(
+        R.FaultPlan.congest_link(N, 0, 2, 2.0, start=10, duration=4))
+    assert both.congested_links(9) == {(0, 2): 4.0}
+    assert both.congested_links(10) == {(0, 2): 8.0}
+    assert both.congested_links(14) == {(0, 2): 4.0}
+    # ... and distinct links report separately
+    two = plan.merged(
+        R.FaultPlan.congest_link(N, 1, 3, 6.0, start=8, duration=10))
+    assert two.congested_links(8) == {(0, 2): 4.0, (1, 3): 6.0}
+    # validation: dst must be a rank, factor must be a slowdown
+    with pytest.raises(ValueError, match="dst"):
+        R.FaultPlan.congest_link(4, 0, 7, 2.0, start=0, duration=1)
+    with pytest.raises(ValueError, match="factor"):
+        R.FaultPlan.congest_link(N, 0, 2, 0.5, start=0, duration=1)
+
+    slow = R.FaultPlan.persistent_straggler(N, 5, 8, stall_seconds=0.25)
+    assert slow.stall_seconds(7) == 0.0
+    np.testing.assert_array_equal(slow.stall_seconds_by_rank(8),
+                                  [0, 0, 0, 0, 0, 0.25, 0, 0])
+    # open-ended: still stalling far past any bench horizon
+    assert slow.stall_seconds_by_rank(500_000)[5] == 0.25
+    # two stalls on one rank add up in the per-rank vector
+    stacked = slow.merged(R.FaultPlan.straggler(
+        N, 5, 10, duration=2, stall_seconds=0.1))
+    assert stacked.stall_seconds_by_rank(10)[5] == pytest.approx(0.35)
+    assert stacked.stall_seconds_by_rank(12)[5] == pytest.approx(0.25)
+
+
 def test_fault_plan_corrupt_batch():
     plan = R.FaultPlan.nan_burst(N, rank=3, step=2)
     x = np.ones((N, 4, 6))

@@ -131,6 +131,43 @@ def test_calibration_shifts_cost_toward_quiet_links():
     assert loads[((1,), 0, 1)] == pytest.approx(1.0)
 
 
+def test_calibration_degenerate_inputs_stay_finite():
+    """The control plane feeds ``calibrated`` whatever a telemetry
+    window produced, including nothing at all.  Degenerate snapshots
+    must degrade to the identity or to finite multipliers — never to
+    inf/nan costs or a synthesis crash.
+
+    (a) empty snapshot and all-zero bytes: calibration is the identity
+        (the SAME object, so a no-traffic window costs nothing);
+    (b) a single saturated link: every multiplier finite, only the
+        links that traffic routed over get more expensive, and
+        ``compile_topology`` over the calibrated pod still emits a
+        row-stochastic, finite-cost schedule."""
+    pod = PodSpec(4, 2, dcn_cost=4.0)
+    assert pod.calibrated({}) is pod
+    assert pod.calibrated({(0, 2): 0.0, (1, 3): 0.0}) is pod
+    assert pod.calibrated({(0, 2): -5.0}) is pod  # negative = no load
+    # one saturated DCN link, orders of magnitude above anything else
+    cal = pod.calibrated({(0, 2): 1e18}, contention=3.0)
+    assert cal is not pod
+    for key, mult in cal.link_cost_overrides:
+        assert np.isfinite(mult) and mult >= 1.0
+        assert np.isfinite(cal.link_cost(key))
+    # the untouched links keep their nominal price
+    sat = {key for key, _ in cal.link_cost_overrides}
+    assert sat  # the saturated route got repriced...
+    quiet = [(4, 6)]  # ...a disjoint machine link did not
+    assert cal.round_cost(quiet) == pytest.approx(pod.round_cost(quiet))
+    compiled = compile_topology(cal)
+    assert np.isfinite(compiled.score["cost_to_consensus"])
+    assert compiled.score["cost_to_consensus"] > 0
+    for rnd in compiled.schedule:
+        M = mixing_matrix(rnd)
+        assert np.isfinite(M).all()
+        np.testing.assert_allclose(M.sum(axis=1), 1.0, atol=1e-12)
+        assert (M >= -1e-12).all()
+
+
 def test_from_telemetry_reads_the_registry():
     """PodSpec.from_telemetry closes the loop with observe.fleet: the
     bf_edge_bytes_total counters the train wrappers publish become
