@@ -430,6 +430,12 @@ def sweep_cases() -> List[dict]:
                               topology=ring))
     cases.append(dict(comm_mode="atc", overlap="none", guard=True,
                       health=True, compress="int8", topology=ring))
+    # error-feedback compressed mixing: the "topk" epilogue threads
+    # MixState through the switch branches — lint it like any other
+    cases.append(dict(comm_mode="cta", overlap="none", guard=False,
+                      health=False, compress="topk", topology=ring))
+    cases.append(dict(comm_mode="atc", overlap="bucketed", guard=True,
+                      health=True, compress="topk", topology=ring))
     for overlap in ("none", "bucketed"):
         for health in (False, True):
             cases.append(dict(comm_mode="push_sum", overlap=overlap,
@@ -446,7 +452,8 @@ def sweep_cases() -> List[dict]:
             ("atc", "none", True, False, None),
             ("atc", "bucketed", False, True, None),
             ("cta", "bucketed", True, True, "int8"),
-            ("atc", "none", True, True, "int8")):
+            ("atc", "none", True, True, "int8"),
+            ("cta", "none", False, False, "topk")):
         cases.append(dict(comm_mode=comm_mode, overlap=overlap,
                           guard=guard, health=health, compress=compress,
                           topology=mring, hierarchical=2))
@@ -494,6 +501,8 @@ def _build_and_check(case: dict, mesh) -> List[Finding]:
     ostate = F.rank_major(opt.init(base), mesh)
     if push_sum:
         ostate = (ostate, F.push_sum_weights(mesh))
+    if getattr(step, "mix_config", None) is not None:
+        ostate = (ostate, step.init_mix_state(params))
     batch = np.zeros((N_RANKS, 3, 4), np.float32)
     args = (params, ostate, batch, jnp.int32(0))
     if guarded:

@@ -13,6 +13,14 @@ Compressors are pure functions (explicit PRNG keys), so they compose with
 jit/shard_map; :func:`compress_gradients` wraps any of them as an optax
 gradient transformation, the functional twin of the reference's
 CompressedOptimizer.
+
+There is ONE top-k kernel and ONE k-resolution rule in the repo:
+:func:`topk_mask_encode` / :func:`topk_mask_decode` (with
+:func:`_resolve_k` for the k/percentage contract) back both the eager
+gradient compressors here AND the error-feedback compressed parameter
+mixing (``parallel.collectives.mix_compress_exchange``, selected via
+``build_train_step(compress="topk")``) — parity between the two paths
+is asserted in tests/test_compressor.py.
 """
 
 from __future__ import annotations
@@ -29,6 +37,8 @@ __all__ = [
     "QuantizedCompressor",
     "compress_gradients",
     "CompressedOptimizer",
+    "topk_mask_encode",
+    "topk_mask_decode",
 ]
 
 
@@ -47,6 +57,56 @@ def _resolve_k(k: Optional[int], percentage: Optional[float], numel: int) -> int
     return min(int(k), numel)
 
 
+def topk_mask_encode(flat: jax.Array, k: int, k_live=None):
+    """THE top-k kernel — shared by the eager gradient compressors and
+    the compressed-mixing wire (``collectives.mix_compress_exchange``).
+
+    Selects the ``k`` largest-magnitude entries of the flat ``[n]``
+    vector and returns ``(mask, vals)``:
+
+    * ``mask`` — boolean ``[n]`` keep-mask (the wire ships it packed,
+      8 entries/byte);
+    * ``vals`` — ``[k]`` kept values in ASCENDING-INDEX order, zeros
+      beyond the live count — exactly the order
+      :func:`topk_mask_decode`'s cumsum reconstruction consumes, so
+      sender and receiver rebuild the identical dense delta bitwise.
+
+    ``k_live`` (optional, may be a TRACED int32 scalar ``<= k``)
+    tightens the kept count at runtime without changing any shape: the
+    control plane's online compression-ratio knob rides it, so a ratio
+    swap is pure data — zero recompiles.  Selection is ``lax.top_k``
+    (ties resolve to the lowest index, deterministically); dropped
+    candidates are routed to out-of-range sentinel positions so the
+    position sort never collides with kept entries.
+    """
+    n = flat.shape[0]
+    _, idx = jax.lax.top_k(jnp.abs(flat), k)
+    live = jnp.arange(k) < (k if k_live is None else k_live)
+    pos = jnp.where(live, idx, n + jnp.arange(k))
+    pos = jnp.sort(pos)
+    valid = pos < n
+    safe = jnp.where(valid, pos, 0)
+    vals = jnp.where(valid, flat[safe], jnp.zeros((), flat.dtype))
+    # scatter-ADD of the valid flags (not set): dropped entries clamp to
+    # position 0, and a duplicate-index set would nondeterministically
+    # clobber a kept True there — addition is order-free
+    mask = jnp.zeros((n,), jnp.int32).at[safe].add(
+        valid.astype(jnp.int32)) > 0
+    return mask, vals
+
+
+def topk_mask_decode(mask: jax.Array, vals: jax.Array) -> jax.Array:
+    """Dense ``[n]`` vector from a keep-mask plus ascending-index
+    values — the inverse of :func:`topk_mask_encode`.  Pure gather
+    (``cumsum(mask) - 1`` ranks each kept position among the kept set),
+    so the same ``(mask, vals)`` pair decodes bitwise-identically on
+    sender and receiver — the consistency the error-feedback mixing
+    state depends on."""
+    cum = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    safe = jnp.clip(cum, 0, vals.shape[0] - 1)
+    return jnp.where(mask, vals[safe], jnp.zeros((), vals.dtype))
+
+
 class TopKCompressor:
     """Keep the k largest-magnitude entries, zero the rest (dense)."""
 
@@ -59,8 +119,7 @@ class TopKCompressor:
     def __call__(self, x: jax.Array, key=None) -> jax.Array:
         flat = x.reshape(-1)
         kk = _resolve_k(self.k, self.percentage, flat.size)
-        _, idx = jax.lax.top_k(jnp.abs(flat), kk)
-        out = jnp.zeros_like(flat).at[idx].set(flat[idx])
+        out = topk_mask_decode(*topk_mask_encode(flat, kk))
         return out.reshape(x.shape)
 
 

@@ -28,6 +28,8 @@ __all__ = [
     "fuse_epilogues",
     "fusion_threshold",
     "hier_local_size",
+    "mix_compress",
+    "mix_compress_ratio",
     "kv_zero_on_free",
     "prefix_cache_mb",
     "replica_stale_s",
@@ -171,6 +173,33 @@ def hier_local_size():
     except ValueError:
         return None
     return v if v >= 1 else None
+
+
+def mix_compress():
+    """BLUEFOG_MIX_COMPRESS (default unset): default WIRE COMPRESSION
+    mode of :func:`bluefog_tpu.optim.functional.build_train_step` for
+    cta/atc steps that did not pass ``compress=`` explicitly —
+    ``int8``, ``int8_sr``, ``bf16``, or ``topk`` (error-feedback
+    compressed mixing; pair with :func:`mix_compress_ratio`).  Unset or
+    unrecognized keeps the full-precision wire.  Explicit builder
+    arguments always win over this env default."""
+    raw = _env("BLUEFOG_MIX_COMPRESS", "").strip().lower()
+    return raw if raw in ("int8", "int8_sr", "bf16", "topk") else None
+
+
+def mix_compress_ratio():
+    """BLUEFOG_MIX_COMPRESS_RATIO (default unset -> builder default):
+    kept fraction of each bucket's elements for the error-feedback
+    compressed mixing wire (``BLUEFOG_MIX_COMPRESS=topk`` or
+    ``compress="topk"``), in (0, 1].  Values >= 1.0 mean "keep
+    everything" and build the uncompressed exchange; out-of-range or
+    unparsable values are ignored (``None``)."""
+    raw = _env("BLUEFOG_MIX_COMPRESS_RATIO", "")
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
 
 
 def kv_zero_on_free() -> bool:

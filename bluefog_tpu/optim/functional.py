@@ -67,6 +67,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bluefog_tpu import config as _config
+from bluefog_tpu.compressor import _resolve_k
 from bluefog_tpu.optim import fusion as _fusion
 from bluefog_tpu.parallel import collectives as C
 from bluefog_tpu.topology.spec import DynamicTopology, Topology
@@ -77,6 +78,8 @@ __all__ = [
     "GuardConfig",
     "HealthConfig",
     "HealthVector",
+    "MixCompressConfig",
+    "MixState",
     "build_train_step",
     "comm_weight_inputs",
     "push_sum_weights",
@@ -169,6 +172,69 @@ class HealthVector(NamedTuple):
     update_norm: Any
     skipped: Any
     consensus: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class MixCompressConfig:
+    """Error-feedback compressed parameter mixing policy for
+    :func:`build_train_step` (``compress="topk"`` is shorthand for the
+    defaults here, with ``BLUEFOG_MIX_COMPRESS_RATIO`` consulted).
+
+    The cta/atc combine's wire payload becomes
+    ``compress(x − ref + e)``: a per-bucket top-k-by-magnitude delta
+    against the reference copy of the last-exchanged state, with the
+    residual accumulating into the per-rank error-feedback state ``e``
+    and receivers reconstructing ``ref + delta``
+    (:func:`bluefog_tpu.parallel.collectives.mix_compress_exchange`).
+
+    * ``ratio`` — kept fraction of each bucket's elements, in (0, 1).
+      This is the BUILD-TIME ratio: it fixes the static per-bucket k
+      (``compressor._resolve_k``) and therefore the wire shapes.  The
+      LIVE ratio is ``MixState.ratio`` — traced data the control plane
+      tightens online (``k_live <= k``) with zero recompiles.  A value
+      >= 1.0 means "keep everything" and builds the ordinary
+      uncompressed exchange (bit-identical by construction).
+    * ``values`` — wire encoding of the kept values: ``"int8"``
+      (absmax per bucket, round-to-nearest — composes the existing
+      int8 stage on top of the sparsity), ``"int8_sr"`` (stochastic
+      rounding, per-step/per-rank/per-bucket PRNG folding), or
+      ``"none"`` (f32 values).
+    * ``error_feedback`` — accumulate the compression residual into
+      ``e`` (the construction that keeps the mixing recursion
+      contractive).  ``False`` drops the residual — the ablation arm of
+      benchmarks/wire_quant_consensus.py's ratio sweep, not a mode to
+      train with.
+    """
+
+    ratio: float = 0.25
+    values: str = "int8"
+    error_feedback: bool = True
+
+
+class MixState(NamedTuple):
+    """Per-rank error-feedback mixing state (rank-major pytree data,
+    carried as the second element of the step's ``opt_state`` —
+    ``(base_opt_state, MixState)``, the same convention as push_sum's
+    weight).  Ordinary traced data: checkpoints, healing rollbacks, and
+    elastic swaps move it with the rest of the state, nothing
+    recompiles.  Build with ``train_step.init_mix_state(params)``.
+
+    * ``ratio`` — ``[n]`` f32, each rank's LIVE compression ratio (the
+      control plane's online knob; starts at the build ratio);
+    * ``err`` — per compressible bucket, ``[n, numel]`` f32
+      error-feedback accumulators;
+    * ``ref`` — per compressible bucket, ``[n, R, numel]`` f32: the
+      sender-side reference copies, one row per schedule round (a
+      rotating schedule pairs different partners per round, so each
+      round integrates its own delta stream);
+    * ``mirror`` — per compressible bucket, ``[n, G, numel]`` f32: the
+      receiver-side mirrors of each in-edge's sender state
+      (``G = sum of mix_mirror_slots(spec) over rounds``)."""
+
+    ratio: Any
+    err: Any
+    ref: Any
+    mirror: Any
 
 
 def _tree_sq_sum(tree) -> jax.Array:
@@ -755,6 +821,7 @@ def _build_fused_train_step(
     n_buckets: Optional[int],
     guard: Optional[GuardConfig],
     health: Optional[HealthConfig],
+    mix: Optional[MixCompressConfig] = None,
 ) -> Callable:
     """The fused per-bucket epilogue pipeline — the default
     :func:`build_train_step` data plane (see its docstring for the
@@ -787,64 +854,131 @@ def _build_fused_train_step(
     wire = compress == "int8_sr"
     wire_compress = "int8" if wire else compress
     zero = lambda: jnp.zeros((), jnp.float32)
+    # error-feedback compressed mixing: per-round sender refs +
+    # per-in-edge receiver mirrors, laid out contiguously over the
+    # schedule (round r's mirror rows live at [offset_r, offset_r+slots))
+    mix_on = mix is not None
+    mix_sr = mix_on and mix.values == "int8_sr"
+    mix_slots = [C.mix_mirror_slots(s) for s in specs] if mix_on else []
+    mix_offsets = list(np.cumsum([0] + mix_slots))
+    stage_compress = compress if not mix_on else (
+        "int8" if mix.values in ("int8", "int8_sr") else None)
 
     def _plan(leaves):
         return _fusion.EpiloguePlan.for_leaves(
-            leaves, n_buckets, compress=compress, guard=guarded,
-            health=want_health, consensus=want_cons)
+            leaves, n_buckets, compress=stage_compress, guard=guarded,
+            health=want_health, consensus=want_cons, mix=mix_on)
 
-    def _fused_combine_branch(spec: CommSpec) -> Callable:
-        """fn(tree, key, w) -> (combined_tree, cons_sq): the per-bucket
-        pipeline over an already-materialized param tree (cta pre-
-        update; guarded/plain atc post-update)."""
+    def _bucket_exchange(pre, spec, key, b, w, mix_state, r_index, ci):
+        """One bucket's exchange stage: the EF-compressed sparse wire
+        for compressible buckets under a mix config (returning the
+        advanced (ref, mirrors, err) slices), the ordinary dense
+        exchange otherwise.  Returns (out, mix_update | None)."""
+        cw, sw = w
+        if mix_on and jnp.issubdtype(jnp.dtype(b.dtype), jnp.inexact):
+            off = mix_offsets[r_index]
+            rows = mix_slots[r_index]
+            numel = int(np.prod(pre.shape))
+            out, nr, nm, ne = C.mix_compress_exchange(
+                pre, spec, axis_name,
+                ref_row=mix_state.ref[ci][r_index],
+                mirrors=mix_state.mirror[ci][off:off + rows],
+                err=mix_state.err[ci],
+                ratio=mix_state.ratio,
+                k=_resolve_k(None, mix.ratio, numel),
+                values=mix.values,
+                error_feedback=mix.error_feedback,
+                class_weights=cw, self_weights=sw,
+                wire_key=(jax.random.fold_in(key, b.index)
+                          if mix_sr else None),
+                hierarchical_local_size=hierarchical_local_size)
+            return out, (nr, nm, ne)
+        if hierarchical_local_size is not None:
+            out = C.hierarchical_neighbor_allreduce(
+                pre, spec, hierarchical_local_size, axis_name,
+                compress=wire_compress,
+                wire_key=(jax.random.fold_in(key, b.index)
+                          if wire else None),
+                class_weights=cw, self_weights=sw)
+        else:
+            out = C.neighbor_allreduce(
+                pre, spec, axis_name, compress=wire_compress,
+                wire_key=(jax.random.fold_in(key, b.index)
+                          if wire else None),
+                class_weights=cw, self_weights=sw)
+        return out, None
 
-        def fn(tree, key, w):
+    def _advance_mix(mix_state, r_index, ci, upd, acc):
+        """Fold one bucket's (ref, mirrors, err) advance into the
+        accumulating (err, ref, mirror) lists."""
+        nr, nm, ne = upd
+        errs, refs, mirs = acc
+        off = mix_offsets[r_index]
+        rows = mix_slots[r_index]
+        refs[ci] = refs[ci].at[r_index].set(nr)
+        mirs[ci] = mirs[ci].at[off:off + rows].set(nm)
+        errs[ci] = ne
+
+    def _mix_result(mix_state, acc):
+        if not mix_on:
+            return mix_state
+        errs, refs, mirs = acc
+        return MixState(ratio=mix_state.ratio, err=tuple(errs),
+                        ref=tuple(refs), mirror=tuple(mirs))
+
+    def _fused_combine_branch(spec: CommSpec, r_index: int) -> Callable:
+        """fn(tree, key, w, mix_state) -> (combined_tree, cons_sq,
+        mix_state'): the per-bucket pipeline over an already-
+        materialized param tree (cta pre-update; guarded/plain atc
+        post-update)."""
+
+        def fn(tree, key, w, mix_state):
             leaves, treedef = jax.tree_util.tree_flatten(tree)
             if not leaves:
-                return tree, zero()
+                return tree, zero(), mix_state
             plan = _plan(leaves)
             outs = [None] * len(leaves)
             cons = zero()
+            acc = ([list(mix_state.err), list(mix_state.ref),
+                    list(mix_state.mirror)] if mix_on else None)
+            ci = 0
             for b in plan.buckets:
                 pre = _pack_bucket(leaves, list(b.leaves))
-                cw, sw = w
-                if hierarchical_local_size is not None:
-                    out = C.hierarchical_neighbor_allreduce(
-                        pre, spec, hierarchical_local_size, axis_name,
-                        compress=wire_compress,
-                        wire_key=(jax.random.fold_in(key, b.index)
-                                  if wire else None),
-                        class_weights=cw, self_weights=sw)
-                else:
-                    out = C.neighbor_allreduce(
-                        pre, spec, axis_name, compress=wire_compress,
-                        wire_key=(jax.random.fold_in(key, b.index)
-                                  if wire else None),
-                        class_weights=cw, self_weights=sw)
+                out, upd = _bucket_exchange(pre, spec, key, b, w,
+                                            mix_state, r_index, ci)
+                if upd is not None:
+                    _advance_mix(mix_state, r_index, ci, upd, acc)
+                    ci += 1
                 if want_cons and jnp.issubdtype(jnp.dtype(b.dtype),
                                                 jnp.inexact):
                     cons = cons + _bucket_cons_sq(pre, out)
                 _unpack_bucket(out, leaves, list(b.leaves), outs)
-            return jax.tree_util.tree_unflatten(treedef, outs), cons
+            return (jax.tree_util.tree_unflatten(treedef, outs), cons,
+                    _mix_result(mix_state, acc))
 
         return fn
 
-    def _fused_apply_combine_branch(spec: CommSpec) -> Callable:
-        """fn((params, updates), key, w) -> (params, cons_sq): the
-        unguarded ATC pipeline — bucket *i*'s optax apply feeds its own
-        exchange before bucket *i+1*'s apply, and the consensus partial
-        comes from the bucket's applied/mixed buffers (the pre-fusion
-        path re-applied the full update tree just to measure it)."""
+    def _fused_apply_combine_branch(spec: CommSpec,
+                                    r_index: int) -> Callable:
+        """fn((params, updates), key, w, mix_state) -> (params,
+        cons_sq, mix_state'): the unguarded ATC pipeline — bucket *i*'s
+        optax apply feeds its own exchange before bucket *i+1*'s apply,
+        and the consensus partial comes from the bucket's applied/mixed
+        buffers (the pre-fusion path re-applied the full update tree
+        just to measure it)."""
 
-        def fn(operand, key, w):
+        def fn(operand, key, w, mix_state):
             params, updates = operand
             leaves, treedef = jax.tree_util.tree_flatten(params)
             upd_leaves = jax.tree_util.tree_flatten(updates)[0]
             if not leaves:
-                return params, zero()
+                return params, zero(), mix_state
             plan = _plan(leaves)
             outs = [None] * len(leaves)
             cons = zero()
+            acc = ([list(mix_state.err), list(mix_state.ref),
+                    list(mix_state.mirror)] if mix_on else None)
+            ci = 0
             for b in plan.buckets:
                 g = list(b.leaves)
                 fresh = list(leaves)
@@ -852,25 +986,17 @@ def _build_fused_train_step(
                     fresh[i] = optax.apply_updates(leaves[i],
                                                    upd_leaves[i])
                 pre = _pack_bucket(fresh, g)
-                cw, sw = w
-                if hierarchical_local_size is not None:
-                    out = C.hierarchical_neighbor_allreduce(
-                        pre, spec, hierarchical_local_size, axis_name,
-                        compress=wire_compress,
-                        wire_key=(jax.random.fold_in(key, b.index)
-                                  if wire else None),
-                        class_weights=cw, self_weights=sw)
-                else:
-                    out = C.neighbor_allreduce(
-                        pre, spec, axis_name, compress=wire_compress,
-                        wire_key=(jax.random.fold_in(key, b.index)
-                                  if wire else None),
-                        class_weights=cw, self_weights=sw)
+                out, upd = _bucket_exchange(pre, spec, key, b, w,
+                                            mix_state, r_index, ci)
+                if upd is not None:
+                    _advance_mix(mix_state, r_index, ci, upd, acc)
+                    ci += 1
                 if want_cons and jnp.issubdtype(jnp.dtype(b.dtype),
                                                 jnp.inexact):
                     cons = cons + _bucket_cons_sq(pre, out)
                 _unpack_bucket(out, fresh, g, outs)
-            return jax.tree_util.tree_unflatten(treedef, outs), cons
+            return (jax.tree_util.tree_unflatten(treedef, outs), cons,
+                    _mix_result(mix_state, acc))
 
         return fn
 
@@ -911,7 +1037,8 @@ def _build_fused_train_step(
 
         return fn
 
-    branches = [_fused_combine_branch(s) for s in specs] \
+    branches = [_fused_combine_branch(s, r)
+                for r, s in enumerate(specs)] \
         if neighbor else []
     # the interleaved apply+exchange rides the BUCKETED unguarded atc
     # path only: on the plain path the whole-tree apply stays outside
@@ -919,64 +1046,72 @@ def _build_fused_train_step(
     # arithmetic is bit-identical to the pre-fusion builder — an apply
     # moved inside a conditional invites a different mul+add
     # contraction (1-ulp) on some backends
-    ac_branches = [_fused_apply_combine_branch(s) for s in specs] \
+    ac_branches = [_fused_apply_combine_branch(s, r)
+                   for r, s in enumerate(specs)] \
         if (neighbor and comm_mode == "atc" and not guarded
             and n_buckets is not None) else []
     ps_branches = [_fused_push_sum_branch(s) for s in specs] \
         if comm_mode == "push_sum" else []
 
-    def fused_combine(params, step, comm_weights):
+    def fused_combine(params, step, comm_weights, mix_state):
         if not branches:
-            return params, zero()
+            return params, zero(), mix_state
 
-        def run(params):
+        def run(operand):
+            params, mix_state = operand
             key = jax.random.fold_in(jax.random.PRNGKey(0x51EED), step)
             if len(branches) == 1:
                 return branches[0](params, key,
                                    comm_weights[0] if use_traced_w
-                                   else ())
+                                   else (), mix_state)
             picked = [
-                (lambda fn, i: lambda p, k, ws: fn(
-                    p, k, ws[i] if use_traced_w else ()))(fn, i)
+                (lambda fn, i: lambda p, k, ws, m: fn(
+                    p, k, ws[i] if use_traced_w else (), m))(fn, i)
                 for i, fn in enumerate(branches)
             ]
             return lax.switch(step % len(branches), picked, params, key,
-                              comm_weights)
+                              comm_weights, mix_state)
 
         if k_comm > 1:
             # lax.cond actually skips the collectives (and the epilogue
-            # stages riding them) on off-cycle steps
+            # stages riding them) on off-cycle steps — the mix state
+            # rides through untouched (no wire, no delta)
             return lax.cond(step % k_comm == 0, run,
-                            lambda p: (p, zero()), params)
-        return run(params)
+                            lambda op: (op[0], zero(), op[1]),
+                            (params, mix_state))
+        return run((params, mix_state))
 
-    def fused_apply_then_combine(params, updates, step, comm_weights):
+    def fused_apply_then_combine(params, updates, step, comm_weights,
+                                 mix_state):
         if not ac_branches:
-            return optax.apply_updates(params, updates), zero()
+            return (optax.apply_updates(params, updates), zero(),
+                    mix_state)
 
         def run(operand):
-            params, updates = operand
+            params, updates, mix_state = operand
             key = jax.random.fold_in(jax.random.PRNGKey(0x51EED), step)
             if len(ac_branches) == 1:
                 return ac_branches[0]((params, updates), key,
                                       comm_weights[0] if use_traced_w
-                                      else ())
+                                      else (), mix_state)
             picked = [
-                (lambda fn, i: lambda op, k, ws: fn(
-                    op, k, ws[i] if use_traced_w else ()))(fn, i)
+                (lambda fn, i: lambda op, k, ws, m: fn(
+                    op, k, ws[i] if use_traced_w else (), m))(fn, i)
                 for i, fn in enumerate(ac_branches)
             ]
             return lax.switch(step % len(ac_branches), picked,
-                              (params, updates), key, comm_weights)
+                              (params, updates), key, comm_weights,
+                              mix_state)
 
         if k_comm > 1:
             # off-cycle steps still apply the optax update — only the
             # collectives (and their epilogue stages) are skipped
             return lax.cond(
                 step % k_comm == 0, run,
-                lambda op: (optax.apply_updates(op[0], op[1]), zero()),
-                (params, updates))
-        return run((params, updates))
+                lambda op: (optax.apply_updates(op[0], op[1]), zero(),
+                            op[2]),
+                (params, updates, mix_state))
+        return run((params, updates, mix_state))
 
     def fused_push_sum(params, ps, step):
         def run(operand):
@@ -992,6 +1127,14 @@ def _build_fused_train_step(
         return run((params, ps))
 
     def per_rank_step(params, aux, opt_state, batch, step, comm_weights):
+        mix_state = ()
+        if mix_on:
+            # the MixState rides opt_state as (base, MixState) — the
+            # push_sum convention; the GUARD's pick below applies to
+            # the base only (the exchange ran on the wire regardless of
+            # a local skip, so ref/mirror/err must advance to stay
+            # bitwise-consistent with what the neighbors received)
+            opt_state, mix_state = opt_state
         loss, grads, new_aux = _loss_and_grads(
             loss_fn, has_aux, sp_axis, pp_axis, param_specs,
             params, aux, batch)
@@ -1017,7 +1160,8 @@ def _build_fused_train_step(
                                None) if want_health else None
             return params, new_aux, (base_state, ps), loss, None, hv
         if comm_mode == "cta":
-            params, cons = fused_combine(params, step, comm_weights)
+            params, cons, mix_state = fused_combine(
+                params, step, comm_weights, mix_state)
         updates, new_opt = optimizer.update(grads, opt_state, params)
         skipped = None
         if guarded:
@@ -1034,17 +1178,20 @@ def _build_fused_train_step(
             new_aux = jax.tree.map(pick, new_aux, aux)
             new_opt = jax.tree.map(pick, new_opt, opt_state)
             if comm_mode == "atc":
-                params, cons = fused_combine(params, step, comm_weights)
+                params, cons, mix_state = fused_combine(
+                    params, step, comm_weights, mix_state)
             skipped = jnp.where(ok, jnp.int32(0), jnp.int32(1))
         else:
             if comm_mode == "atc" and ac_branches:
-                params, cons = fused_apply_then_combine(
-                    params, updates, step, comm_weights)
+                params, cons, mix_state = fused_apply_then_combine(
+                    params, updates, step, comm_weights, mix_state)
             else:
                 params = optax.apply_updates(params, updates)
                 if comm_mode == "atc":
-                    params, cons = fused_combine(params, step,
-                                                 comm_weights)
+                    params, cons, mix_state = fused_combine(
+                        params, step, comm_weights, mix_state)
+        if mix_on:
+            new_opt = (new_opt, mix_state)
         hv = _fused_health(loss, grad_sq, updates, groups, cons,
                            skipped) if want_health else None
         return params, new_aux, new_opt, loss, skipped, hv
@@ -1078,10 +1225,27 @@ def _build_fused_train_step(
         return outs
 
     p_rank = P(axis_name)
+    # MixState layout: dim 0 is ranks; the packed/flat axis (last)
+    # shards over every OTHER mesh axis, matching the per-device bucket
+    # shards the exchange packs (see init_mix_state / _local_shapes)
+    _mix_rest = tuple(a for a in mesh.axis_names if a != axis_name)
+    p_mix = MixState(
+        ratio=p_rank,
+        err=P(axis_name, _mix_rest or None),
+        ref=P(axis_name, None, _mix_rest or None),
+        mirror=P(axis_name, None, _mix_rest or None))
     if batch_specs is None:
         batch_specs = p_rank
     p_params = param_specs if param_specs is not None else p_rank
     p_opt = opt_state_specs if opt_state_specs is not None else p_rank
+    if mix_on:
+        # opt_state = (base, MixState), a per-FIELD pytree-prefix spec:
+        # the ratio is one scalar per rank, but err/ref/mirror hold one
+        # flat EF row per DEVICE — their packed axis shards over every
+        # non-rank mesh axis so a tp slice sees exactly its own bucket
+        # shards (P(axis_name) alone would hand each device the full
+        # per-rank row, 4x the bucket under tp=4)
+        p_opt = (p_opt, p_mix)
     # comm weights ride replicated (every rank reads the full tables)
     p_comm = tuple((P(), P()) for _ in specs) if use_traced_w else ()
     out_specs = (p_params, p_rank, p_opt, p_rank)
@@ -1112,8 +1276,115 @@ def _build_fused_train_step(
         if (specs and needs_topo) else None
 
     stages = _fusion.epilogue_stages(
-        compress=compress, guard=guarded, health=want_health,
-        consensus=want_cons)
+        compress=stage_compress, guard=guarded, health=want_health,
+        consensus=want_cons, mix=mix_on)
+
+    def _local_shapes(params):
+        """Per-DEVICE leaf shapes exactly as the shard_map body sees
+        them: the leading rank axis stripped, every other dim divided
+        by the mesh axes its param spec shards over.  ``_plan`` buckets
+        on these shapes inside the trace, so every MixState buffer must
+        be sized by them too — under model parallelism (a
+        ``param_specs`` tree naming other mesh axes) the EF state
+        follows the SHARDS, one independent accumulator per device."""
+        leaves = jax.tree.leaves(params)
+        is_p = lambda s: s is None or isinstance(s, P)
+        if param_specs is None:
+            sp = [P(axis_name)] * len(leaves)
+        elif is_p(param_specs):
+            sp = [param_specs] * len(leaves)
+        else:
+            sp = jax.tree.leaves(param_specs, is_leaf=is_p)
+        if len(sp) != len(leaves):
+            raise ValueError(
+                "compressed mixing needs param_specs to be None, one "
+                "PartitionSpec, or a tree matching params exactly "
+                f"(got {len(sp)} specs for {len(leaves)} leaves)")
+        out = []
+        for l, s in zip(leaves, sp):
+            dims = list(np.shape(l))
+            for i, names in enumerate(tuple(s or ())):
+                if names is None:
+                    continue
+                for a in ((names,) if isinstance(names, str)
+                          else tuple(names)):
+                    dims[i] //= int(mesh.shape[a])
+            out.append(jax.ShapeDtypeStruct(
+                tuple(dims[1:]),
+                getattr(l, "dtype", None) or jnp.asarray(l).dtype))
+        return out
+
+    def init_mix_state(params):
+        """The MixState for rank-major ``params`` (attach it as
+        ``opt_state = (base_opt_state, init_mix_state(params))``).
+
+        ``ref``/``mirror`` start at each rank's OWN packed parameters:
+        exact when every rank holds identical parameters at the start
+        (the rank_major broadcast init — the normal case), so round
+        one's wire already carries small deltas.  Ranks that start from
+        DIVERGED states should zero ``ref``/``mirror`` instead (always
+        bitwise-consistent, at the cost of sparse early rounds).
+        Under a hierarchical exchange the same identical-init
+        assumption makes the packed params equal the machine means.
+
+        Built THROUGH a shard_map over the step's own mesh/specs, so
+        the buffers are packed per device shard and land sharded as
+        ``mix_state_specs`` — bitwise the layout the train step's
+        exchange indexes into, whatever the model-parallel layout."""
+        R = len(specs)
+        G = int(sum(mix_slots))
+
+        def body(p):
+            leaves = [l[0] for l in jax.tree.leaves(p)]
+            errs, refs, mirs = [], [], []
+            for b in _plan(leaves).buckets:
+                if not jnp.issubdtype(jnp.dtype(b.dtype), jnp.inexact):
+                    continue
+                flat = _pack_bucket(leaves, list(b.leaves)) \
+                    .reshape(-1).astype(jnp.float32)
+                errs.append(jnp.zeros((1, flat.size), jnp.float32))
+                refs.append(jnp.broadcast_to(
+                    flat[None, None, :], (1, R, flat.size)) + 0.0)
+                mirs.append(jnp.broadcast_to(
+                    flat[None, None, :], (1, G, flat.size)) + 0.0)
+            return MixState(
+                ratio=jnp.full((1,), jnp.float32(mix.ratio)),
+                err=tuple(errs), ref=tuple(refs), mirror=tuple(mirs))
+
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(p_params,),
+            out_specs=p_mix, check_vma=False))(params)
+
+    def mix_wire_layout(params):
+        """Per compressible bucket, the host-side wire facts the
+        collectives contract audits against: ``(bucket_index, numel,
+        k, wire_bytes)`` — ``wire_bytes`` being the single uint8
+        payload each ppermute of that bucket moves per DCN pair.
+        ``numel`` is the per-DEVICE packed size (model-parallel layouts
+        exchange shards, so each tp slice moves its own wire)."""
+        rows = []
+        shapes = _local_shapes(params)
+        for b in _plan(shapes).buckets:
+            if not jnp.issubdtype(jnp.dtype(b.dtype), jnp.inexact):
+                continue
+            numel = int(sum(
+                int(np.prod(s.shape))
+                for i, s in enumerate(shapes) if i in b.leaves))
+            k = _resolve_k(None, mix.ratio, numel)
+            rows.append(dict(bucket=b.index, numel=numel, k=k,
+                             wire_bytes=C.mix_wire_bytes(
+                                 numel, k, mix.values)))
+        return tuple(rows)
+
+    def set_mix_ratio(opt_state, ratio):
+        """A new opt_state with every rank's LIVE compression ratio set
+        to ``ratio`` — pure data (``k_live`` masking inside the traced
+        program), so the swap never recompiles.  The control plane's
+        sanctioned step-boundary producer
+        (``topology.control.swap_mix_ratio``) feeds this."""
+        base, ms = opt_state
+        return (base, ms._replace(
+            ratio=jnp.full_like(ms.ratio, jnp.float32(float(ratio)))))
 
     def _decorate(step_fn, adapt):
         # ``adapt`` maps the step's PUBLIC signature to the jitted
@@ -1128,6 +1399,14 @@ def _build_fused_train_step(
         step_fn.has_aux = has_aux
         step_fn.hierarchical_local_size = \
             hierarchical_local_size if neighbor else None
+        step_fn.mix_config = mix
+        if mix_on:
+            step_fn.init_mix_state = init_mix_state
+            step_fn.mix_wire_layout = mix_wire_layout
+            step_fn.set_mix_ratio = set_mix_ratio
+            # pytree-prefix PartitionSpecs of the MixState (AOT callers
+            # turn these into NamedShardings for abstract avals)
+            step_fn.mix_state_specs = p_mix
         if guarded:
             step_fn.guard_config = guard
         if guarded or use_traced_w:
@@ -1211,7 +1490,7 @@ def build_train_step(
     opt_state_specs: Any = None,
     donate: bool = True,
     has_aux: bool = False,
-    compress: Optional[str] = None,
+    compress: Union[str, MixCompressConfig, None] = None,
     overlap: str = "none",
     overlap_buckets: int = 4,
     guard: Optional[GuardConfig] = None,
@@ -1257,6 +1536,35 @@ def build_train_step(
     n=128 floor comparison is benchmarks/wire_quant_consensus.py.
     ``compress="bf16"`` rounds the wire payload to bfloat16 (2x less
     traffic for f32 params, self term stays full precision).
+
+    ``compress="topk"`` (or an explicit :class:`MixCompressConfig`)
+    is ERROR-FEEDBACK COMPRESSED MIXING — the sparsity rung below
+    int8.  Each rank keeps a per-bucket reference copy of its
+    last-exchanged state plus an error accumulator; the wire carries
+    ``topk(x − ref + e)`` as a packed keep-mask + (int8-quantized)
+    kept values (``collectives.mix_compress_exchange`` /
+    ``mix_wire_bytes``), the residual folds into ``e``, and receivers
+    reconstruct ``ref + delta`` so the mixing recursion stays
+    contractive (consensus floor vs ratio: benchmarks/
+    wire_quant_consensus.py's ratio sweep).  The step's ``opt_state``
+    must then be ``(base_opt_state, train_step.init_mix_state(params))``
+    — the ref/error state is ordinary rank-major pytree data, so
+    checkpoints, healing rollbacks, and elastic swaps carry it with
+    everything else.  k is FIXED at build time from the config ratio
+    (static shapes — the zero-recompile contract); the LIVE ratio is
+    ``MixState.ratio``, traced data the topology control plane
+    tightens online under congestion (``topology.control.
+    swap_mix_ratio`` → ``train_step.set_mix_ratio``) with zero
+    recompiles.  ``ratio >= 1.0`` builds the ordinary uncompressed
+    exchange (bit-identical by construction).  cta/atc only; under a
+    hierarchical exchange the sparse wire rides the DCN leg only (the
+    ICI machine reduce stays exact, ref/mirror state at machine-mean
+    granularity).  Env defaults: ``BLUEFOG_MIX_COMPRESS`` /
+    ``BLUEFOG_MIX_COMPRESS_RATIO`` (explicit arguments win).  Needs
+    the fused epilogue pipeline (not available under
+    ``BLUEFOG_FUSE_EPILOGUES=0``) and does not compose with the
+    string wire modes (the int8 stage already rides the kept
+    values).
 
     ``overlap="bucketed"`` (cta/atc only) is the overlap engine: the
     param tree is split into ``overlap_buckets`` size-balanced buckets
@@ -1395,6 +1703,35 @@ def build_train_step(
             "pp_axis requires param_specs: the spec tree is what tells "
             "pipeline-sharded leaves (layer stacks, NOT reduced over pp) "
             "apart from pp-replicated ones (embeddings/head, psum'd)")
+    if compress is None and comm_mode in ("cta", "atc"):
+        # BLUEFOG_MIX_COMPRESS supplies the default wire mode when the
+        # builder did not choose one (explicit arguments always win)
+        compress = _config.mix_compress()
+    mix = None
+    if isinstance(compress, MixCompressConfig):
+        mix, compress = compress, None
+    elif compress == "topk":
+        env_ratio = _config.mix_compress_ratio()
+        mix = (MixCompressConfig() if env_ratio is None
+               else MixCompressConfig(ratio=env_ratio))
+        compress = None
+    if mix is not None:
+        if comm_mode not in ("cta", "atc"):
+            raise ValueError(
+                "compress='topk' (error-feedback compressed mixing) "
+                "rides the cta/atc combine only "
+                f"(got comm_mode={comm_mode!r})")
+        if mix.values not in ("int8", "int8_sr", "none"):
+            raise ValueError(
+                f"unknown MixCompressConfig values mode {mix.values!r}")
+        if not mix.ratio > 0:
+            raise ValueError(
+                f"MixCompressConfig.ratio must be > 0, got {mix.ratio}")
+        if mix.ratio >= 1.0:
+            # keep-everything: build the ordinary uncompressed exchange
+            # so ratio=1.0 is bit-identical to compress=None by
+            # construction (no wire round-trip to be identical THROUGH)
+            mix = None
     if compress is not None:
         if compress not in ("int8", "int8_sr", "bf16"):
             raise ValueError(f"unknown compress mode {compress!r}")
@@ -1435,8 +1772,14 @@ def build_train_step(
             param_specs=param_specs, opt_state_specs=opt_state_specs,
             donate=donate, has_aux=has_aux, compress=compress,
             n_buckets=overlap_buckets if bucketed else None,
-            guard=guard, health=health)
+            guard=guard, health=health, mix=mix)
     # ------- BLUEFOG_FUSE_EPILOGUES=0: the pre-fusion builders -------
+    if mix is not None:
+        raise ValueError(
+            "compress='topk' (error-feedback compressed mixing) needs "
+            "the fused epilogue pipeline — unset "
+            "BLUEFOG_FUSE_EPILOGUES=0 (the pre-fusion builders have no "
+            "ef_encode/ef_decode stages)")
     if comm_mode == "push_sum" and bucketed:
         raise ValueError(
             "overlap='bucketed' with comm_mode='push_sum' needs the "

@@ -56,32 +56,44 @@ __all__ = [
 # applied to the train-step epilogue (PAPERS.md: HiCCL):
 #
 #   pack         gather the bucket's leaves into one flat buffer
-#   quantize     wire compression encode (int8 absmax / bf16 round)
+#   ef_encode    error-feedback delta + top-k sparsify (compressed
+#                mixing: wire becomes compress(x - ref + e), residual
+#                folds into e — collectives.mix_compress_exchange)
+#   quantize     wire compression encode (int8 absmax / bf16 round;
+#                under ef_encode it quantizes the kept top-k VALUES)
 #   exchange     the bucket's own neighbor collective
 #   dequantize   wire decode + weighted combine (f32 accumulation)
+#   ef_decode    receiver-side reconstruction ref + delta (the mirror
+#                integration of the sparse wire)
 #   guard_select per-rank skip: elementwise select against last-good
 #   health_norm  partial grad/update sq-sums for the HealthVector
 #   consensus    partial ||pre - mixed||^2 from the exchange's own
 #                buffers (no re-mix, no second tree walk)
 #   unpack       scatter the combined buffer back to leaf shapes
 EPILOGUE_STAGE_ORDER = (
-    "pack", "quantize", "exchange", "dequantize", "guard_select",
-    "health_norm", "consensus", "unpack",
+    "pack", "ef_encode", "quantize", "exchange", "dequantize",
+    "ef_decode", "guard_select", "health_norm", "consensus", "unpack",
 )
 
 
 def epilogue_stages(compress=None, guard: bool = False,
                     health: bool = False,
-                    consensus: bool = False) -> Tuple[str, ...]:
+                    consensus: bool = False,
+                    mix: bool = False) -> Tuple[str, ...]:
     """The epilogue stage list a feature combination composes to, in
     canonical order.  ``pack``/``exchange``/``unpack`` are always
     present (a single-leaf bucket's pack/unpack are identity);
     ``quantize``/``dequantize`` ride with wire compression,
+    ``ef_encode``/``ef_decode`` with error-feedback compressed mixing
+    (``compress="topk"``, where ``quantize``/``dequantize`` then apply
+    to the kept top-k values if the mix config says so),
     ``guard_select`` with a GuardConfig, ``health_norm`` with a
     HealthConfig, and ``consensus`` with ``HealthConfig.consensus``."""
     on = {"pack", "exchange", "unpack"}
     if compress:
         on |= {"quantize", "dequantize"}
+    if mix:
+        on |= {"ef_encode", "ef_decode"}
     if guard:
         on.add("guard_select")
     if health:
@@ -120,7 +132,8 @@ class EpiloguePlan:
     @classmethod
     def for_leaves(cls, leaves, n_buckets, *, compress=None,
                    guard: bool = False, health: bool = False,
-                   consensus: bool = False) -> "EpiloguePlan":
+                   consensus: bool = False,
+                   mix: bool = False) -> "EpiloguePlan":
         rows = bucket_signature(leaves)
         if n_buckets is None:
             groups = [[i] for i in range(len(rows))]
@@ -128,7 +141,8 @@ class EpiloguePlan:
             threshold = size_balanced_threshold(rows, n_buckets)
             groups = plan_groups(rows, threshold)
         stages = epilogue_stages(compress=compress, guard=guard,
-                                 health=health, consensus=consensus)
+                                 health=health, consensus=consensus,
+                                 mix=mix)
         buckets = tuple(
             EpilogueBucket(
                 index=b,

@@ -64,6 +64,9 @@ __all__ = [
     "hierarchical_neighbor_allreduce",
     "machine_groups",
     "validate_machine_decomposition",
+    "mix_compress_exchange",
+    "mix_wire_bytes",
+    "mix_mirror_slots",
 ]
 
 
@@ -761,3 +764,268 @@ def hierarchical_neighbor_allreduce(
     for r, w in zip(received, weights):
         acc = acc + r.astype(acc_dtype) * w
     return acc.astype(x.dtype)
+
+
+# ------------------------------------------------------------------ #
+# error-feedback compressed mixing: sparse deltas on the wire
+# ------------------------------------------------------------------ #
+def mix_wire_bytes(numel: int, k: int, values: str = "int8") -> int:
+    """Host-side byte count of one compressed-mixing wire buffer — the
+    single uint8 payload :func:`mix_compress_exchange` permutes per
+    bucket per class: ``k`` quantized values (1 byte each under int8,
+    4 under ``values="none"``), the packed keep-mask (8 entries/byte),
+    and — int8 only — the 4-byte f32 absmax scale.  This is the number
+    the collectives contract (``predicted_collectives`` /
+    ``verify_collective_contract``) charges per permute, so the cost
+    model and the lowering can never disagree about the sparse wire."""
+    numel, k = int(numel), int(k)
+    mask_bytes = (numel + 7) // 8
+    if values in ("int8", "int8_sr"):
+        return k + mask_bytes + 4
+    return 4 * k + mask_bytes
+
+
+def mix_mirror_slots(spec: CommSpec) -> int:
+    """Number of receiver-side mirror rows one round of ``spec`` needs:
+    1 when the round's shift classes fuse into a single permute (every
+    src and dst unique across ALL classes — each rank then has at most
+    one in-edge, the class-fusion rule of :func:`neighbor_allreduce`),
+    else one per class (a rank may receive from several senders and
+    must track each sender's cumulative deltas separately).  Host-side,
+    trace-time — the mixing-state allocator and the exchange must agree
+    on this layout."""
+    classes = spec.shift_classes
+    if len(classes) <= 1:
+        return max(len(classes), 1)
+    all_pairs = [p for cls in classes for p in cls.perm]
+    srcs = [s for s, _ in all_pairs]
+    dsts = [d for _, d in all_pairs]
+    if len(set(srcs)) == len(srcs) and len(set(dsts)) == len(dsts):
+        return 1
+    return len(classes)
+
+
+def _mix_encode_wire(target: jax.Array, k: int, k_live, values: str,
+                     key: Optional[jax.Array]):
+    """(wire uint8 [mix_wire_bytes], dense_delta f32 [n]): top-k select
+    the delta, quantize the kept values, pack everything into ONE flat
+    byte buffer, and decode it back — the sender's own dense delta is
+    recomputed FROM THE WIRE BYTES so it is bitwise what every receiver
+    will decode (the ref/mirror consistency invariant)."""
+    from bluefog_tpu.compressor import topk_mask_encode
+
+    n = target.shape[0]
+    mask, vals = topk_mask_encode(target, k, k_live)
+    packed = jnp.packbits(mask)
+    if values in ("int8", "int8_sr"):
+        q, scale = _wire_quantize_int8(vals, key)
+        wire = jnp.concatenate([
+            lax.bitcast_convert_type(q, jnp.uint8),
+            packed,
+            lax.bitcast_convert_type(scale, jnp.uint8),
+        ])
+    else:
+        wire = jnp.concatenate([
+            lax.bitcast_convert_type(vals.astype(jnp.float32),
+                                     jnp.uint8).reshape(-1),
+            packed,
+        ])
+    return wire, _mix_decode_wire(wire, n, k, values)
+
+
+def _mix_decode_wire(wire: jax.Array, n: int, k: int,
+                     values: str) -> jax.Array:
+    """Dense f32 [n] delta from one wire buffer.  A ppermute delivers
+    all-zero bytes to ranks with no in-edge in the class; the zero mask
+    then decodes to an exactly-zero delta, so receivers never need an
+    explicit has-in-edge gate."""
+    from bluefog_tpu.compressor import topk_mask_decode
+
+    mask_bytes = (n + 7) // 8
+    if values in ("int8", "int8_sr"):
+        q = lax.bitcast_convert_type(wire[:k], jnp.int8)
+        packed = wire[k:k + mask_bytes]
+        scale = lax.bitcast_convert_type(
+            wire[k + mask_bytes:k + mask_bytes + 4], jnp.float32)
+        vals = q.astype(jnp.float32) * scale
+    else:
+        vals = lax.bitcast_convert_type(
+            wire[:4 * k].reshape(k, 4), jnp.float32)
+        packed = wire[4 * k:4 * k + mask_bytes]
+    mask = jnp.unpackbits(packed, count=n).astype(bool)
+    return topk_mask_decode(mask, vals)
+
+
+def mix_compress_exchange(
+    x: jax.Array,
+    spec: CommSpec,
+    axis_name: str,
+    *,
+    ref_row: jax.Array,
+    mirrors: jax.Array,
+    err: jax.Array,
+    ratio: jax.Array,
+    k: int,
+    values: str = "int8",
+    error_feedback: bool = True,
+    class_weights: Optional[jax.Array] = None,
+    self_weights: Optional[jax.Array] = None,
+    wire_key: Optional[jax.Array] = None,
+    hierarchical_local_size: Optional[int] = None,
+):
+    """ONE round of error-feedback compressed neighbor averaging.
+
+    The wire carries ``compress(x - ref + e)`` instead of ``x``: each
+    rank keeps a reference copy ``ref`` of what it has cumulatively
+    told this round's receivers (per round, because a rotating schedule
+    pairs different partners per round) and an error accumulator ``e``;
+    the payload is the top-k-by-magnitude sparsification of the delta
+    (packed keep-mask + int8-quantized values, see
+    :func:`mix_wire_bytes`), the residual accumulates into ``e``, and
+    every receiver reconstructs the sender's full state as
+    ``mirror + delta`` — ``mirror`` being its own cumulative record of
+    that sender's deltas, bitwise equal to the sender's ``ref`` by
+    construction (both integrate the identical decoded byte stream from
+    the same starting point).  The combine is then the ordinary
+    weighted average of full-precision reconstructions, so the mixing
+    recursion stays contractive; what compression costs is absorbed by
+    the error feedback instead of compounding (the ratio sweep in
+    benchmarks/wire_quant_consensus.py measures the floor, EF on vs
+    off).
+
+    Args (all state flat f32, allocated by the train-step builder):
+
+    * ``ref_row`` — ``[n]``: this ROUND's cumulative sent deltas.
+    * ``mirrors`` — ``[mix_mirror_slots(spec), n]``: cumulative
+      received deltas, one row per in-edge slot of this round.
+    * ``err`` — ``[n]``: the error-feedback accumulator (shared across
+      rounds; pass and ignore under ``error_feedback=False``).
+    * ``ratio`` — traced f32 scalar: the LIVE compression ratio.  The
+      static ``k`` (from the build-time ratio) fixes every shape and
+      the physical wire bytes; ``ratio`` masks the active prefix
+      (``k_live = clip(floor(ratio * n), 1, k)``), so the control
+      plane tightens sparsity online with zero recompiles.
+    * ``k`` — static per-bucket kept count
+      (``compressor._resolve_k``).
+    * ``values`` — ``"int8"`` (absmax per bucket, round-to-nearest),
+      ``"int8_sr"`` (stochastic rounding via ``wire_key``), or
+      ``"none"`` (f32 values on the wire).
+    * ``hierarchical_local_size`` — compress the DCN leg only: ``x``
+      is first reduced to the exact intra-machine mean (ICI psum, full
+      precision) and ref/mirror/err live at MACHINE-mean granularity;
+      ``spec``/weights are machine-level, counterpart-expanded like
+      :func:`hierarchical_neighbor_allreduce`.
+
+    Returns ``(out, new_ref_row, new_mirrors, new_err)`` with ``out``
+    in ``x``'s shape/dtype and the state advanced — the caller owns the
+    slot bookkeeping across rounds.  A rank (or machine) with no
+    out-edge this round leaves ``ref``/``err`` untouched; a rank with
+    no in-edge receives zero bytes and leaves its mirror untouched.
+    """
+    if values not in ("int8", "int8_sr", "none"):
+        raise ValueError(f"unknown mix values mode {values!r}")
+    if wire_key is not None and values != "int8_sr":
+        raise ValueError("wire_key= requires values='int8_sr'")
+    if values == "int8_sr" and wire_key is None:
+        raise ValueError("values='int8_sr' needs a wire_key")
+    shape, dtype = x.shape, x.dtype
+    xf = x.reshape(-1)
+    nb = xf.size
+    idx = lax.axis_index(axis_name)
+    if hierarchical_local_size is not None:
+        L = int(hierarchical_local_size)
+        n_total = spec.size * L
+        groups = validate_machine_decomposition(n_total, L, (spec,))
+        base = lax.psum(xf.astype(jnp.float32), axis_name,
+                        axis_index_groups=groups) / L
+        unit = idx // L
+
+        def expand(perm):
+            return [(ms * L + j, md * L + j)
+                    for (ms, md) in perm for j in range(L)]
+    else:
+        base = xf.astype(jnp.float32)
+        unit = idx
+        expand = list
+    if wire_key is not None:
+        wire_key = jax.random.fold_in(wire_key, idx)
+
+    classes_all = spec.shift_classes
+    if not classes_all:
+        if self_weights is None:
+            sw = jnp.asarray(_self_weights_of(spec), jnp.float32)[unit]
+        else:
+            sw = self_weights.astype(jnp.float32)[unit]
+        return ((base * sw).astype(dtype).reshape(shape), ref_row,
+                mirrors, err)
+
+    # sender side: encode the delta once per round (the same wire goes
+    # to every out-edge), fold the residual into e, advance ref — but
+    # only for ranks/machines that actually have an out-edge this round
+    target = base - ref_row + err
+    k_live = jnp.clip(jnp.floor(ratio * nb).astype(jnp.int32), 1, k)
+    wire, d_own = _mix_encode_wire(target, k, k_live, values, wire_key)
+    classes = spec.shift_classes
+    has_out_tbl = np.zeros(spec.size, bool)
+    for cls in classes:
+        for (s, _) in cls.perm:
+            has_out_tbl[s] = True
+    has_out = jnp.asarray(has_out_tbl)[unit]
+    new_ref = jnp.where(has_out, ref_row + d_own, ref_row)
+    if error_feedback:
+        new_err = jnp.where(has_out, target - d_own, err)
+    else:
+        new_err = err
+
+    if self_weights is None:
+        self_w = jnp.asarray(_self_weights_of(spec),
+                             dtype=jnp.float32)[unit]
+    else:
+        self_w = self_weights.astype(jnp.float32)[unit]
+
+    # receiver side: mirror the class-fusion rule of the uncompressed
+    # exchange — in-degree-1 disjoint rounds move ONE permute of the
+    # one wire buffer and need ONE mirror row; multi-class rounds
+    # permute the same wire per class and integrate per-slot
+    fused = len(classes) > 1 and mix_mirror_slots(spec) == 1
+    acc = base * self_w
+    if fused or len(classes) == 1:
+        if fused:
+            all_pairs = sorted(p for cls in classes for p in cls.perm)
+            perm = tuple(expand(all_pairs))
+            if class_weights is None:
+                w = jnp.asarray(
+                    np.sum([cls.recv_weights for cls in classes],
+                           axis=0), jnp.float32)[unit]
+            else:
+                masks = np.zeros((len(classes), spec.size))
+                for c, cls in enumerate(classes):
+                    for _, d in cls.perm:
+                        masks[c, d] = 1.0
+                w = (class_weights.astype(jnp.float32)
+                     * jnp.asarray(masks, jnp.float32)).sum(0)[unit]
+        else:
+            perm = tuple(expand(classes[0].perm))
+            if class_weights is None:
+                w = jnp.asarray(classes[0].recv_weights,
+                                jnp.float32)[unit]
+            else:
+                w = class_weights[0].astype(jnp.float32)[unit]
+        rd = _mix_decode_wire(lax.ppermute(wire, axis_name, perm),
+                              nb, k, values)
+        new_mirrors = mirrors.at[0].add(rd)
+        acc = acc + new_mirrors[0] * w
+    else:
+        new_mirrors = mirrors
+        for c, cls in enumerate(classes):
+            rd = _mix_decode_wire(
+                lax.ppermute(wire, axis_name, tuple(expand(cls.perm))),
+                nb, k, values)
+            new_mirrors = new_mirrors.at[c].add(rd)
+            if class_weights is None:
+                w = jnp.asarray(cls.recv_weights, jnp.float32)[unit]
+            else:
+                w = class_weights[c].astype(jnp.float32)[unit]
+            acc = acc + new_mirrors[c] * w
+    return (acc.astype(dtype).reshape(shape), new_ref, new_mirrors,
+            new_err)

@@ -51,6 +51,23 @@ All the hysteresis knobs default from ``BLUEFOG_TOPOLOGY_REPLAN_*``
 tables are produced for a running step is :func:`swap_comm_weights` —
 the analysis lint's ``weight-swap-outside-boundary`` rule flags
 in-place mutation of live weight operands anywhere else.
+
+When the train step was built with error-feedback compressed mixing
+(``compress="topk"``), the plane also owns the live compression ratio:
+``mix_ratios`` is a strictly descending ladder whose first rung is the
+BUILD ratio (the static ``k``; every other rung must be below it, since
+the live ratio only masks a prefix of the baked wire slots).  The same
+windowed degradation signal that triggers a re-plan first tries the
+cheaper lever — step one rung DOWN the ladder (fewer wire bytes, pure
+traced data, zero recompiles) — and only synthesizes a new topology
+once the ladder is exhausted.  A ratio step is on probation exactly
+like a topology swap (consensus health watched, rollback past
+tolerance, commit after clean steps), and ``mix_recover_windows``
+consecutive clean windows step back UP toward the build ratio, so a
+transient congestion event does not permanently coarsen the mixing.
+The one sanctioned producer of the live ratio is
+:func:`swap_mix_ratio`, feeding ``train_step.set_mix_ratio`` at the
+same step boundary ``swap_comm_weights`` delivers weight tables.
 """
 
 from __future__ import annotations
@@ -70,7 +87,7 @@ from bluefog_tpu.topology.compiler import PodSpec, Sketch, compile_topology, \
 from bluefog_tpu.topology.spec import DynamicTopology
 from bluefog_tpu.topology.torus import rounds_from_contraction
 
-__all__ = ["TopologyControlPlane", "swap_comm_weights"]
+__all__ = ["TopologyControlPlane", "swap_comm_weights", "swap_mix_ratio"]
 
 # state machine (docs/topology.md draws it): STEADY watches windows,
 # SYNTHESIZING has a re-plan in flight, CANDIDATE_READY holds an
@@ -92,6 +109,17 @@ def swap_comm_weights(plane: "TopologyControlPlane", dead_mask) -> tuple:
     from bluefog_tpu.resilience.healing import healed_comm_weights
 
     return healed_comm_weights(plane.active_schedule(), dead_mask)
+
+
+def swap_mix_ratio(plane: "TopologyControlPlane") -> float:
+    """The sanctioned step-boundary delivery for the live compression
+    ratio: the plane's active rung of the ``mix_ratios`` ladder, to be
+    fed straight into ``train_step.set_mix_ratio`` after a
+    ``mix_ratio_swap`` / ``mix_ratio_rollback`` event.  The ratio is
+    pure traced data (the static top-k ``k`` was sized for the BUILD
+    ratio — the ladder's first rung — and every lower rung only masks
+    a prefix of those slots), so delivery costs zero recompiles."""
+    return plane.mix_ratio()
 
 
 def _consensus_distance(params, live: np.ndarray) -> float:
@@ -165,7 +193,10 @@ class TopologyControlPlane:
     transitions trigger).  ``candidates_fn(pod, dead_mask)`` overrides
     candidate generation (yields ``(name, schedule)`` pairs).
     ``health_fn(params, live_mask)`` overrides the probation health
-    signal."""
+    signal.  ``mix_ratios`` (strictly descending, first rung = the
+    BUILD ratio) arms the compression-ratio ladder described in the
+    module docstring; ``mix_recover_windows`` clean windows step the
+    ratio back up toward the build rung."""
 
     def __init__(self, pod: PodSpec, carrier: Sequence[DynamicTopology], *,
                  sketch: Optional[Sketch] = None,
@@ -185,7 +216,9 @@ class TopologyControlPlane:
                  use_compiler: bool = True,
                  candidates_fn: Optional[Callable] = None,
                  health_fn: Optional[Callable] = None,
-                 initial: Optional[Sequence[DynamicTopology]] = None):
+                 initial: Optional[Sequence[DynamicTopology]] = None,
+                 mix_ratios: Optional[Sequence[float]] = None,
+                 mix_recover_windows: int = 2):
         carrier = tuple(carrier)
         if not carrier:
             raise ValueError("control plane needs a non-empty carrier "
@@ -221,6 +254,23 @@ class TopologyControlPlane:
         self.use_compiler = bool(use_compiler)
         self._candidates_fn = candidates_fn
         self._health_fn = health_fn or _consensus_distance
+        if mix_ratios is not None:
+            ladder = tuple(float(r) for r in mix_ratios)
+            if len(ladder) < 2:
+                raise ValueError(
+                    "mix_ratios needs at least two rungs (the build "
+                    "ratio plus one fallback) to be a ladder")
+            if any(r <= 0.0 for r in ladder):
+                raise ValueError("mix_ratios must all be positive")
+            if any(b >= a for a, b in zip(ladder, ladder[1:])):
+                raise ValueError(
+                    "mix_ratios must be strictly descending — the "
+                    "first rung is the BUILD ratio (it sized the "
+                    "static k) and every later rung must fit inside "
+                    "its wire slots")
+            mix_ratios = ladder
+        self.mix_ratios = mix_ratios
+        self.mix_recover_windows = int(mix_recover_windows)
 
         from bluefog_tpu.observe.fleet import TrafficDeltas
 
@@ -248,9 +298,20 @@ class TopologyControlPlane:
         self._steps_seen = 0
         self._thread: Optional[threading.Thread] = None
         self._async_events: List[Tuple[str, dict]] = []
+        # mix-ratio ladder position: index 0 = the build ratio.  A
+        # pending probation mirrors the topology machine's fields but
+        # stays independent of ``self._state`` (the topology machine
+        # keeps STEADY while a ratio step is on probation).
+        self._mix_index = 0
+        self._mix_prev_index: Optional[int] = None
+        self._mix_probation_end: Optional[int] = None
+        self._mix_preswap_health: Optional[float] = None
+        self._mix_clean_windows = 0
         self.swaps = 0
         self.rollbacks = 0
         self.triggers = 0
+        self.mix_swaps = 0
+        self.mix_rollbacks = 0
         self.last_scores: Dict[str, float] = {}
 
     # ------------------------------------------------------------ #
@@ -276,6 +337,17 @@ class TopologyControlPlane:
     def healed_weights(self, dead_mask) -> tuple:
         """:func:`swap_comm_weights` on the active schedule."""
         return swap_comm_weights(self, dead_mask)
+
+    def mix_ratio(self) -> float:
+        """The ACTIVE rung of the ``mix_ratios`` ladder (raises when
+        the plane was built without one)."""
+        if self.mix_ratios is None:
+            raise ValueError(
+                "this control plane has no mix_ratios ladder — pass "
+                "mix_ratios=(build_ratio, ...) to let it drive the "
+                "live compression ratio")
+        with self._lock:
+            return self.mix_ratios[self._mix_index]
 
     # ------------------------------------------------------------ #
     # projection: candidate -> carrier-shaped specs
@@ -584,6 +656,42 @@ class TopologyControlPlane:
                     events.append(("topology_commit",
                                    {"schedule": self._active_name}))
                 return events
+            # mix-ratio probation verdict: mirrors the topology
+            # machine's, but independently of ``self._state`` (which
+            # stays STEADY while a ratio step is on probation)
+            if self._mix_probation_end is not None:
+                if params is not None:
+                    health = self._health_fn(params, ~dead)
+                    if self._mix_preswap_health is None:
+                        self._mix_preswap_health = health
+                    elif health > (self._mix_preswap_health
+                                   * self.rollback_tolerance) + 1e-12:
+                        restored = self._mix_prev_index
+                        bad = self._mix_index
+                        preswap = self._mix_preswap_health
+                        self._mix_index = restored
+                        self._mix_prev_index = None
+                        self._mix_probation_end = None
+                        self._mix_preswap_health = None
+                        self._cooldown_until = step + self.cooldown
+                        self.mix_rollbacks += 1
+                        self._count("mix_rollback")
+                        events.append(("mix_ratio_rollback", {
+                            "restored": self.mix_ratios[restored],
+                            "ratio": self.mix_ratios[bad],
+                            "health": health,
+                            "preswap_health": preswap,
+                        }))
+                        return events
+                if step >= self._mix_probation_end:
+                    self._mix_prev_index = None
+                    self._mix_probation_end = None
+                    self._mix_preswap_health = None
+                    self._cooldown_until = step + self.cooldown
+                    self._count("mix_commit")
+                    events.append(("mix_ratio_commit", {
+                        "ratio": self.mix_ratios[self._mix_index]}))
+                return events
             if state == CANDIDATE_READY and self._pending is not None:
                 name, proj, sc = self._pending
                 self._pending = None
@@ -626,9 +734,32 @@ class TopologyControlPlane:
                 degraded, worst = self._window_degraded(secs, z)
                 if degraded:
                     self._degraded_streak += 1
+                    self._mix_clean_windows = 0
                 else:
                     self._degraded_streak = 0
+                    self._mix_clean_windows += 1
+                    # recovery: clean windows step the ratio back UP
+                    # toward the build rung (compression costs mixing
+                    # fidelity, so run the finest ratio the network
+                    # affords); the step is on probation like any other
+                    if (self.mix_ratios is not None
+                            and self._mix_index > 0
+                            and self._mix_clean_windows
+                            >= self.mix_recover_windows):
+                        self._mix_ladder_step(
+                            step, self._mix_index - 1, "recover",
+                            dead, params, events)
+                        return events
                 if self._degraded_streak >= self.patience:
+                    # the cheap lever first: a rung DOWN the ladder is
+                    # pure traced data; synthesis only once exhausted
+                    if (self.mix_ratios is not None
+                            and self._mix_index
+                            < len(self.mix_ratios) - 1):
+                        self._mix_ladder_step(
+                            step, self._mix_index + 1, "degraded",
+                            dead, params, events)
+                        return events
                     reason = "degraded"
             if reason is None:
                 return events
@@ -647,6 +778,28 @@ class TopologyControlPlane:
                 name="bf-topology-replan", daemon=True)
             self._thread.start()
         return events
+
+    def _mix_ladder_step(self, step: int, to_index: int, reason: str,
+                         dead: np.ndarray, params, events) -> None:
+        """Move the ladder to ``to_index`` and open probation on the
+        step (caller holds the lock).  The new rung is live the moment
+        the caller delivers it through :func:`swap_mix_ratio`."""
+        prev = self._mix_index
+        self._mix_prev_index = prev
+        self._mix_index = to_index
+        self._mix_probation_end = step + self.probation
+        self._mix_preswap_health = (
+            self._health_fn(params, ~dead)
+            if params is not None else None)
+        self._mix_clean_windows = 0
+        self._degraded_streak = 0
+        self.mix_swaps += 1
+        self._count("mix_swap")
+        events.append(("mix_ratio_swap", {
+            "ratio": self.mix_ratios[to_index],
+            "previous": self.mix_ratios[prev],
+            "reason": reason,
+        }))
 
     def join(self, timeout: Optional[float] = None) -> None:
         """Wait for an in-flight background synthesis (tests)."""

@@ -364,3 +364,59 @@ def test_gate_catches_no_adaptation_regression(capsys):
     # ... and the committed record gates clean against itself
     ok2, _ = bench_compare(base, base)
     assert ok2 is True
+
+# --------------------------------------------------------------------- #
+# compressed-mixing baseline (ISSUE 17): the EF top-k audit joins the
+# gate flow — compressed.dcn_bytes_per_step is a gated lower-is-better
+# headline, so an encoder change that silently re-inflates the sparse
+# wire (k drift, mask packing, scale width) fails the compare
+# --------------------------------------------------------------------- #
+@pytest.mark.hier
+def test_compressed_audit_baseline_is_committed_and_defended():
+    """The committed r17 record carries the compressed-mixing audit
+    with every machine-checked claim true: every lowered permute
+    payload byte-exact against the mix_wire_layout prediction, DCN
+    bytes/step at most HALF the r14 int8-only hierarchical record at
+    the same layout, and the live ratio swap aval-invariant (the
+    zero-recompile property)."""
+    base = _load(os.path.join("benchmarks",
+                              "llama_8b_measured_r17.json"))
+    comp = base["compressed"]
+    claims = comp["claims"]
+    assert claims["predicted_collectives_byte_exact"] is True
+    assert claims["contract_problems"] == []
+    assert claims["ratio_swap_avals_unchanged"] is True
+    assert claims["dcn_bytes_halved"] is True
+    assert claims["dcn_bytes_vs_int8_only"] <= 0.5
+    r14 = _load(os.path.join("benchmarks",
+                             "llama_8b_measured_r14.json"))
+    assert (comp["dcn_bytes_per_step"] <= 0.5 *
+            r14["hierarchical"]["hierarchical"]["dcn_bytes_per_step"])
+    # ... and the r17 record does not regress the r14 hierarchical leg
+    assert (base["hierarchical"]["hierarchical"]["dcn_bytes_per_step"]
+            <= r14["hierarchical"]["hierarchical"]["dcn_bytes_per_step"])
+    # the gate sees the compressed headline field
+    from bluefog_tpu.benchutil import bench_headline
+
+    head = bench_headline(base)
+    assert "compressed.dcn_bytes_per_step" in head
+
+
+@pytest.mark.hier
+def test_gate_catches_compressed_wire_regression(capsys):
+    """A change that doubles the compressed wire (e.g. shipping dense
+    int8 where the top-k payload should be) fails the gate — lower is
+    better for compressed.dcn_bytes_per_step."""
+    from bluefog_tpu.benchutil import bench_compare
+
+    base = _load(os.path.join("benchmarks",
+                              "llama_8b_measured_r17.json"))
+    regressed = copy.deepcopy(base)
+    regressed["compressed"]["dcn_bytes_per_step"] *= 2.0
+    ok, rows = bench_compare(regressed, base, tolerance=0.25)
+    assert ok is False
+    bad = {r["name"] for r in rows if r["regressed"]}
+    assert "compressed.dcn_bytes_per_step" in bad
+    # ... and the committed record gates clean against itself
+    ok2, _ = bench_compare(base, base)
+    assert ok2 is True

@@ -362,3 +362,32 @@ def test_functional_int8_sr_combine_converges():
                                           jnp.int32(i))
     xs = np.asarray(params["x"])
     assert np.abs(xs - x_true).max() < 0.2, np.abs(xs - x_true).max()
+
+
+def test_topk_compressor_and_mix_kernel_share_one_path():
+    """The eager TopKCompressor and the compressed-mixing wire resolve
+    k and select entries through the SAME kernels (_resolve_k +
+    topk_mask_encode/decode): identical kept sets and identical dense
+    reconstructions, including the traced ``k_live`` masking that the
+    control plane's live ratio rides."""
+    from bluefog_tpu.compressor import (_resolve_k, topk_mask_decode,
+                                        topk_mask_encode)
+
+    x = jnp.asarray(np.random.RandomState(3).randn(257), jnp.float32)
+    for k, pct in ((7, None), (None, 0.25), (None, 0.031)):
+        kk = _resolve_k(k, pct, x.size)
+        dense = TopKCompressor(k=k, percentage=pct)(x)
+        mask, vals = topk_mask_encode(x, kk)
+        assert int(np.asarray(mask).sum()) == kk
+        np.testing.assert_array_equal(
+            np.asarray(topk_mask_decode(mask, vals)), np.asarray(dense))
+    # k_live masks the active prefix of a FIXED-k encoding: the decode
+    # equals a smaller-k encode while every shape stays put (the
+    # zero-recompile property the live ratio swap depends on)
+    mask, vals = topk_mask_encode(x, 32, k_live=jnp.int32(9))
+    m9, v9 = topk_mask_encode(x, 9)
+    assert vals.shape == (32,) and v9.shape == (9,)
+    assert int(np.asarray(mask).sum()) == 9
+    np.testing.assert_array_equal(
+        np.asarray(topk_mask_decode(mask, vals)),
+        np.asarray(topk_mask_decode(m9, v9)))

@@ -414,3 +414,111 @@ def test_epilogue_plan_carries_stage_lists():
     assert step.epilogue_stages == (
         "pack", "quantize", "exchange", "dequantize", "health_norm",
         "consensus", "unpack")
+
+
+# ------------------------------------------------------------------ #
+# compressed mixing with error feedback (ISSUE 17)
+# ------------------------------------------------------------------ #
+def _mix_problem_state(mesh, step):
+    """(params, (base_opt_state, MixState)) for a mix-enabled step."""
+    base, _ = _problem()
+    params = F.rank_major(base, mesh)
+    ostate = F.rank_major(_OPT.init(base), mesh)
+    return params, (ostate, step.init_mix_state(params))
+
+
+def test_mix_ratio_one_short_circuits_to_dense(monkeypatch):
+    """``MixCompressConfig(ratio>=1.0)`` drops the whole mixing
+    apparatus at BUILD time (``step.mix_config is None``, plain
+    signature, no MixState) and the trajectory is bit-identical to an
+    uncompressed build — identity by construction, not by tolerance."""
+    mesh = _mesh()
+    kwargs = dict(comm_mode="cta", topology=_weighted_ring(),
+                  overlap="bucketed", overlap_buckets=2)
+    dense = _build(monkeypatch, True, **kwargs)
+    one = _build(monkeypatch, True,
+                 compress=F.MixCompressConfig(ratio=1.0), **kwargs)
+    assert one.mix_config is None
+    assert not hasattr(one, "init_mix_state")
+    pA, _, lA, _, _ = _run(dense, mesh, guarded=False, steps=3)
+    pB, _, lB, _, _ = _run(one, mesh, guarded=False, steps=3)
+    np.testing.assert_array_equal(np.asarray(lA), np.asarray(lB))
+    for a, b in zip(jax.tree.leaves(pA), jax.tree.leaves(pB)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mix_state_checkpoint_roundtrip(monkeypatch, tmp_path):
+    """The EF state survives a checkpoint: save mid-run, restore with
+    ``like=`` (preserving the MixState/optax NamedTuple containers),
+    and the restored trajectory continues bit-identically to the live
+    one — ref/mirror consistency is state, so it must round-trip."""
+    from bluefog_tpu.checkpoint import Checkpointer
+
+    mesh = _mesh()
+    step = _build(monkeypatch, True, comm_mode="cta",
+                  topology=_weighted_ring(),
+                  compress=F.MixCompressConfig(ratio=0.5, values="int8"),
+                  overlap="bucketed", overlap_buckets=2)
+    assert step.epilogue_stages == (
+        "pack", "ef_encode", "quantize", "exchange", "dequantize",
+        "ef_decode", "unpack")
+    params, state = _mix_problem_state(mesh, step)
+    for s in range(2):
+        params, state, _ = step(params, state, _batch(mesh, s),
+                                jnp.int32(s))
+    ck = Checkpointer(str(tmp_path))
+    ck.save(2, {"params": params, "state": state})
+
+    base, _ = _problem()
+    p_t = F.rank_major(base, mesh)
+    template = {"params": p_t,
+                "state": (F.rank_major(_OPT.init(base), mesh),
+                          step.init_mix_state(p_t))}
+    got = ck.restore(2, mesh=mesh, like=template)
+    rp, rs = got["params"], got["state"]
+    assert isinstance(rs[1], F.MixState)
+    for s in range(2, 4):
+        b = _batch(mesh, s)
+        params, state, live_loss = step(params, state, b, jnp.int32(s))
+        rp, rs, rest_loss = step(rp, rs, b, jnp.int32(s))
+    np.testing.assert_array_equal(np.asarray(live_loss),
+                                  np.asarray(rest_loss))
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(rp)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mix_heal_grow_ratio_swap_zero_recompile(monkeypatch):
+    """The full elastic cycle on a guarded compressed step — heal a
+    dead rank (weight DATA swap), grow it back, then drop the live
+    compression ratio — all through ONE compiled program: the jit
+    cache holds exactly one entry throughout, and every loss stays
+    finite (the EF state keeps advancing through the swaps)."""
+    from bluefog_tpu.resilience.healing import healed_comm_weights
+
+    mesh = _mesh()
+    ring = _weighted_ring()
+    step = _build(monkeypatch, True, comm_mode="atc", topology=ring,
+                  compress=F.MixCompressConfig(ratio=0.25),
+                  overlap="bucketed", overlap_buckets=2,
+                  guard=F.GuardConfig(), health=F.HealthConfig())
+    params, state = _mix_problem_state(mesh, step)
+    dead = np.zeros(N, bool)
+    dead[2] = True
+    healed = healed_comm_weights([ring], dead)
+    plans = [step.default_comm_weights,   # healthy
+             healed,                      # rank 2 dead: healed DATA
+             step.default_comm_weights,   # grown back
+             step.default_comm_weights]   # post ratio swap
+    losses = []
+    for s, w in enumerate(plans):
+        if s == 3:
+            # the control plane's sanctioned boundary: pure data
+            state = step.set_mix_ratio(state, 0.1)
+        params, state, loss, _, hv = step(
+            params, state, _batch(mesh, s), jnp.int32(s), w)
+        losses.append(float(loss[0]))
+        assert step.jitted._cache_size() == 1, s
+    assert all(np.isfinite(l) for l in losses)
+    assert np.isfinite(np.asarray(jax.tree.leaves(hv))).all()
+    # the live ratio really moved (pure data, same compiled program)
+    assert float(np.asarray(state[1].ratio)[0]) == pytest.approx(0.1)

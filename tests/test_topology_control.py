@@ -475,3 +475,113 @@ def test_shrink_swap_cycle_zero_recompiles_e2e(tmp_path):
     # the live ranks kept training through the swap
     assert res.step == 20
     assert R.update_health(res.params)[~res.dead_mask].all()
+
+
+# ------------------------------------------------------------------ #
+# (f) the mix-ratio ladder (ISSUE 17): the cheap lever before
+#     re-synthesis — step DOWN on a degraded streak, probation with
+#     health rollback, step back UP on clean windows, and only an
+#     exhausted ladder falls through to a topology trigger
+# ------------------------------------------------------------------ #
+class _ForcedPlane(TopologyControlPlane):
+    """Degradation verdict pinned by the test (the detector's inputs
+    are exercised by the (c) tests; the ladder tests drive the state
+    machine directly)."""
+
+    degraded = True
+
+    def _window_degraded(self, secs, z):
+        return self.degraded, 9.9
+
+
+def _ladder_plane(**kw):
+    kw.setdefault("window", 1)
+    kw.setdefault("patience", 2)
+    kw.setdefault("cooldown", 0)
+    kw.setdefault("probation", 2)
+    kw.setdefault("synchronous", True)
+    kw.setdefault("use_compiler", False)
+    kw.setdefault("mix_ratios", (0.25, 0.1, 0.05))
+    return _ForcedPlane(_pod(), _carrier(1), **kw)
+
+
+def _ladder_params():
+    return {"x": np.zeros((N, 3))}
+
+
+def test_mix_ladder_steps_down_commits_and_recovers():
+    """Degraded streak -> one rung down (reason 'degraded') -> commit
+    after probation; degradation clears -> clean windows step back UP
+    toward the build ratio (reason 'recover') -> commit.  Every live
+    value comes from the sanctioned swap_mix_ratio producer."""
+    from bluefog_tpu.topology.control import swap_mix_ratio
+
+    health = {"v": 1.0}
+    plane = _ladder_plane(mix_recover_windows=2,
+                          health_fn=lambda p, live: health["v"])
+    assert swap_mix_ratio(plane) == 0.25
+    events = []
+    for step in range(1, 30):
+        for kind, data in plane.on_step(step, params=_ladder_params()):
+            events.append((kind, data.get("ratio"), data.get("reason")))
+        if (swap_mix_ratio(plane) == 0.1
+                and ("mix_ratio_commit", 0.1, None) in events):
+            plane.degraded = False
+        if swap_mix_ratio(plane) == 0.25 and not plane.degraded:
+            break
+    kinds = [e[0] for e in events]
+    assert ("mix_ratio_swap", 0.1, "degraded") in events
+    assert ("mix_ratio_commit", 0.1, None) in events
+    assert ("mix_ratio_swap", 0.25, "recover") in events
+    assert swap_mix_ratio(plane) == 0.25
+    assert kinds.count("mix_ratio_rollback") == 0
+    assert plane.mix_swaps >= 2 and plane.mix_rollbacks == 0
+
+
+def test_mix_ladder_rolls_back_on_worse_health():
+    """Health blowing past rollback_tolerance x the pre-swap baseline
+    during a rung's probation restores the previous rung."""
+    from bluefog_tpu.topology.control import swap_mix_ratio
+
+    health = {"v": 1.0}
+    plane = _ladder_plane(patience=1, probation=5,
+                          mix_ratios=(0.25, 0.1),
+                          health_fn=lambda p, live: health["v"])
+    evs = plane.on_step(1, params=_ladder_params())
+    assert [k for k, _ in evs] == ["mix_ratio_swap"]
+    assert swap_mix_ratio(plane) == 0.1
+    health["v"] = 10.0  # consensus blew up under the coarser ratio
+    evs = plane.on_step(2, params=_ladder_params())
+    assert [k for k, _ in evs] == ["mix_ratio_rollback"]
+    assert swap_mix_ratio(plane) == 0.25
+    assert plane.mix_rollbacks == 1
+
+
+def test_mix_ladder_exhausted_falls_through_to_topology():
+    """With every rung spent and degradation persisting, the plane
+    falls through to the topology path (a synthesis trigger) instead
+    of spinning on the ladder."""
+    from bluefog_tpu.topology.control import swap_mix_ratio
+
+    plane = _ladder_plane(patience=1, probation=1,
+                          mix_ratios=(0.25, 0.1),
+                          health_fn=lambda p, live: 0.0)
+    seen = []
+    for step in range(1, 12):
+        seen += [k for k, _ in plane.on_step(step,
+                                             params=_ladder_params())]
+        if "topology_trigger" in seen:
+            break
+    assert "topology_trigger" in seen
+    assert swap_mix_ratio(plane) == 0.1  # parked on the last rung
+
+
+def test_mix_ladder_validation():
+    """The ladder must be >= 2 strictly descending positive rungs
+    (rung 0 is the BUILD ratio that sized the static k), and
+    mix_ratio() without a ladder raises instead of guessing."""
+    for bad in [(0.25,), (0.25, 0.3), (0.25, 0.0), (0.25, 0.25)]:
+        with pytest.raises(ValueError):
+            _ladder_plane(mix_ratios=bad)
+    with pytest.raises(ValueError):
+        _plane().mix_ratio()
