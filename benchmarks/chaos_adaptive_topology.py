@@ -125,72 +125,33 @@ def dcn_round(direction):
     return DynamicTopology.from_edges(N, ew, [0.5] * N)
 
 
-class VirtualWire:
-    """Per-step virtual transport.  Each step the ACTIVE
-    (nonzero-weight, healed) edges of the live round are routed onto
-    the pod's torus links; the step's charge is the bottleneck link's
-    ``load * link_cost * congestion_factor`` (two rank pairs sharing a
-    DCN link serialize — the same contention model ``round_cost``
-    prices), where a ``congest_link`` fault slows every link its rank
-    pair routes over.  Each edge is also billed its own
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bluefog_tpu.sim.wire import LinkWire  # noqa: E402
+
+
+class VirtualWire(LinkWire):
+    """Per-step virtual transport — now a thin wrapper over the sim
+    package's :class:`~bluefog_tpu.sim.wire.LinkWire` (the billing
+    math moved there verbatim, so the committed r16 baselines stay
+    valid): each step the ACTIVE (nonzero-weight, healed) edges of the
+    live round are routed onto the pod's torus links; the step's
+    charge is the bottleneck link's ``load * link_cost *
+    congestion_factor``; each edge is billed its own
     ``pod.round_cost([edge]) * factor * WIRE_UNIT`` seconds into the
     registry — the ``record_edge_timing`` feed the control plane's
-    windowed deltas read.
-
-    The p50 claims are over PERIODS: the mean charge of each complete
-    ``ROUNDS``-step schedule cycle is one sample (a per-step median of
-    an alternating cheap-ICI/expensive-DCN series is a knife-edge —
-    whichever side has one extra sample wins)."""
+    windowed deltas read.  The p50 claims are over complete
+    ``ROUNDS``-step schedule periods."""
 
     def __init__(self, pod, registry, schedule_fn, dead_fn, plan=None):
-        self.pod = pod
-        self.registry = registry
-        self.schedule_fn = schedule_fn
-        self.dead_fn = dead_fn
+        super().__init__(
+            pod, registry, schedule_fn, dead_fn,
+            congestion_fn=(plan.congested_links
+                           if plan is not None else None),
+            wire_unit=WIRE_UNIT, period=ROUNDS)
         self.plan = plan
-        self.charges = []  # (step, bottleneck cost units)
-
-    def _round_charge(self, pairs, cong):
-        from bluefog_tpu.topology.torus import link_loads
-
-        loads = link_loads(pairs, self.pod.torus)
-        if not loads:
-            return 0.0
-        fac = {}
-        for p, f in cong.items():
-            for k in link_loads([p], self.pod.torus):
-                fac[k] = max(fac.get(k, 1.0), float(f))
-        return max(load * self.pod.link_cost(k) * fac.get(k, 1.0)
-                   for k, load in loads.items())
-
-    def bill(self, step):
-        from bluefog_tpu.observe.fleet import record_edge_timing
-        from bluefog_tpu.resilience import heal_spec
-
-        spec = heal_spec(self.schedule_fn(step), self.dead_fn())
-        cong = (self.plan.congested_links(step)
-                if self.plan is not None else {})
-        pairs = [e for e, v in zip(spec.edges, spec.edge_weight_values)
-                 if v != 0.0]
-        for e in pairs:
-            t = self.pod.round_cost([e]) * cong.get(e, 1.0)
-            record_edge_timing(None, t * WIRE_UNIT,
-                               registry=self.registry, pairs=[e])
-        self.charges.append((step, self._round_charge(pairs, cong)))
-
-    def p50(self, lo, hi):
-        """Median per-step charge over the complete schedule periods
-        inside ``[lo, hi)``."""
-        by_step = dict(self.charges)
-        period_means = []
-        first = (lo + ROUNDS - 1) // ROUNDS
-        for p in range(first, hi // ROUNDS):
-            steps = range(p * ROUNDS, (p + 1) * ROUNDS)
-            if all(s in by_step for s in steps):
-                period_means.append(
-                    float(np.mean([by_step[s] for s in steps])))
-        return (float(np.median(period_means)) if period_means
-                else float("nan"))
 
 
 def _training_setup(seed, hetero=0.0):

@@ -106,16 +106,10 @@ def parse_args(argv=None):
     return args
 
 
-class _Clock:
-    """The fleet simulation's shared virtual clock (injected into every
-    replica, so TTFT/latency percentiles come out of the engines' own
-    metrics in virtual seconds)."""
-
-    def __init__(self):
-        self.t = 0.0
-
-    def __call__(self):
-        return self.t
+# the fleet simulation's shared virtual clock (injected into every
+# replica, so TTFT/latency percentiles come out of the engines' own
+# metrics in virtual seconds) — the sim package's one implementation
+from bluefog_tpu.sim.clock import VirtualClock as _Clock  # noqa: E402
 
 
 def make_trace(args):

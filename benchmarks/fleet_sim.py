@@ -1,0 +1,325 @@
+"""Fleet-scale discrete-event simulation bench: the REAL control plane
+at n=1024 training ranks plus a 16-replica serving fleet through a
+million-request trace — entirely in virtual time, on one CPU.
+
+Two scenarios, one committed-constant :class:`CostModel` (committed so
+the headline numbers and event-log digests are run-to-run exact — no
+wall-clock measurement enters any gated figure):
+
+* **sim_training** — 1024 ranks (128 machines x 8 chips) under the real
+  :class:`TopologyControlPlane` + :class:`MembershipController` +
+  :class:`StragglerDetector`: a DCN link congests 6x mid-run (windowed
+  detection -> menu synthesis -> hot-swap -> probation commit), a rank
+  is preempted and rejoins through the membership controller's real
+  healing/bootstrap re-renders, and a persistent straggler is named by
+  the real z-score detector.  Headlines: post-swap p50 virtual step
+  seconds, adapted/congested step-time ratio, detection-to-swap latency
+  in virtual seconds.
+
+* **sim_serving** — 16 simulated replicas behind the real
+  :class:`FleetRouter` (gossip-scraped snapshots, seeded backoff)
+  serving a 1,000,000-request flash-crowd trace
+  (``flash_crowd_arrivals``): one replica dies mid-run (token-exact
+  failover through the router's dead-masked walk), then the flash crowd
+  saturates the survivors and backpressure sheds load.  Headlines:
+  virtual tokens/s and lost requests — the latter gated at ZERO
+  tolerance (the trace is seeded; any drift is a routing change).
+
+The default ``--compare`` flow gates against the committed baseline
+JSON exactly like the other chaos benches (``--compare ''`` disables).
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bluefog_tpu.benchutil import flash_crowd_arrivals  # noqa: E402
+from bluefog_tpu.elastic import MembershipController  # noqa: E402
+from bluefog_tpu.observe import MetricsRegistry  # noqa: E402
+from bluefog_tpu.observe.fleet import StragglerDetector  # noqa: E402
+from bluefog_tpu.resilience import (FaultPlan,  # noqa: E402
+                                    ServingFaultPlan)
+from bluefog_tpu.sim import (ChurnSchedule, CostModel,  # noqa: E402
+                             EventLog, LinkWire, RequestTrace,
+                             SimReplica, SimServingFleet,
+                             SimTrainingFleet, Simulation, VirtualClock)
+from bluefog_tpu.topology import (DynamicTopology, PodSpec,  # noqa: E402
+                                  TopologyControlPlane)
+
+# ------------------------------------------------------------------ #
+# the committed timebase: every gated figure is a pure function of
+# these constants plus the seeds — nothing here is measured
+# ------------------------------------------------------------------ #
+N = 1024
+MACHINES, LOCAL = 128, 8
+SHIFTS = (1, 8, 64, 512)
+ROUNDS = 2
+WIRE_UNIT = 1e-3
+TRAIN_COST = CostModel(train_step_s=1e-3, wire_unit_s=WIRE_UNIT)
+SERVE_COST = CostModel(step_s=20e-3, gossip_round_s=0.0)
+
+CONGEST_AT = 12          # DCN link (8 -> 16) degrades 6x here
+PREEMPT_AT, PREEMPT_FOR = 32, 8   # rank 700 preempted, later rejoins
+STRAGGLE_AT, STRAGGLE_FOR = 54, 6  # rank 33 stalls 0.3 s/step
+N_REPLICAS = 16
+DEATH_TICK = 8000        # replica-3 death (virtual t = 160 s)
+BURST_AT, BURST_FOR, BURST_FACTOR = 300.0, 20.0, 3.0
+BASE_RATE = 900.0        # requests / virtual second
+
+
+# ------------------------------------------------------------------ #
+# training: n=1024 through the real control plane
+# ------------------------------------------------------------------ #
+def _carrier():
+    w = 1.0 / (len(SHIFTS) + 1)
+    ew = {(i, (i + s) % N): w for s in SHIFTS for i in range(N)}
+    return [DynamicTopology.from_edges(N, ew, [w] * N)] * ROUNDS
+
+
+def _shift_round(s):
+    ew = {(i, (i + s) % N): 0.5 for i in range(N)}
+    return DynamicTopology.from_edges(N, ew, [0.5] * N)
+
+
+def _menu(pod, dead):
+    """Explicit candidate menu (``candidates_fn`` shape): ring and an
+    exp2-style schedule, both expressed over the carrier's shifts and
+    both avoiding the congested shift-8 DCN edges."""
+    out = []
+    for name, ss in (("ring", (1, 1)), ("exp2", (1, 64))):
+        out.append((name, [_shift_round(s) for s in ss]))
+    return out
+
+
+def _train_plan(steps):
+    plan = FaultPlan.congest_link(N, 8, 16, 6.0, start=CONGEST_AT,
+                                  duration=steps)
+    plan = plan.merged(FaultPlan.preempt(N, 700, PREEMPT_AT,
+                                         PREEMPT_FOR))
+    return plan.merged(FaultPlan.persistent_straggler(
+        N, 33, STRAGGLE_AT, 0.3, duration=STRAGGLE_FOR))
+
+
+def training_scenario(steps, seed):
+    pod = PodSpec(MACHINES, LOCAL, ici_cost=1.0, dcn_cost=4.0)
+    reg = MetricsRegistry()
+    plan = _train_plan(steps)
+    sdet = StragglerDetector(N, registry=reg)
+    control = TopologyControlPlane(
+        pod, _carrier(), registry=reg, straggler=sdet, window=4,
+        patience=2, degrade_ratio=1.3, margin=0.01, cooldown=8,
+        probation=6, contention=3.0, synchronous=True,
+        initial=[_shift_round(8), _shift_round(1)],
+        candidates_fn=_menu)
+    membership = MembershipController(control.active_schedule(),
+                                      bootstrap_rounds=4)
+    holder = {}
+    wire = LinkWire(
+        pod, reg,
+        schedule_fn=lambda s: control.active_schedule()[s % ROUNDS],
+        dead_fn=lambda: holder["fleet"].dead_mask(),
+        congestion_fn=plan.congested_links,
+        wire_unit=WIRE_UNIT, period=ROUNDS)
+    fleet = SimTrainingFleet(
+        control=control, wire=wire, membership=membership,
+        straggler=sdet, fault_plan=plan,
+        churn=ChurnSchedule.from_fault_plan(plan, steps, admit_after=2,
+                                            promote_after=8),
+        cost=TRAIN_COST,
+        sim=Simulation(log=EventLog(keep_lines=False)))
+    holder["fleet"] = fleet
+    summary = fleet.run(steps)
+
+    swap = next((s for k, s, _ in fleet.events
+                 if k == "topology_swap" and s >= CONGEST_AT), None)
+    commit = next((s for k, s, _ in fleet.events
+                   if k == "topology_commit"
+                   and swap is not None and s >= swap), None)
+    p50_healthy = fleet.p50_step_s(2, CONGEST_AT)
+    p50_congested = (fleet.p50_step_s(CONGEST_AT, swap)
+                     if swap is not None else float("nan"))
+    p50_adapted = (fleet.p50_step_s(commit + 1, commit + 9)
+                   if commit is not None else float("nan"))
+    d2s = fleet.detect_to_swap(CONGEST_AT)
+    flagged = sorted({d["rank"] for k, _, d in fleet.events
+                      if k == "straggler"})
+    return {
+        "ranks": N,
+        "steps": steps,
+        "virtual_seconds": summary["virtual_seconds"],
+        "p50_healthy_s": p50_healthy,
+        "p50_congested_s": p50_congested,
+        "p50_adapted_s": p50_adapted,
+        "swap_step": swap,
+        "commit_step": commit,
+        "detect_to_swap_steps": d2s["steps"],
+        "detect_to_swap_virtual_s": d2s["virtual_seconds"],
+        "trigger_reasons": [d.get("reason") for k, _, d in fleet.events
+                            if k == "topology_trigger"],
+        "active_schedule_at_end": control.active_name(),
+        "dead_at_end": summary["dead"],
+        "weight_renders": summary["weight_renders"],
+        "flagged_stragglers": flagged,
+        "event_counts": summary["event_counts"],
+        "event_digest": summary["event_digest"],
+    }
+
+
+# ------------------------------------------------------------------ #
+# serving: a million requests through the real router
+# ------------------------------------------------------------------ #
+def serving_scenario(n_requests, seed):
+    arrivals = flash_crowd_arrivals(BASE_RATE, n_requests,
+                                    seed=seed + 3, at=BURST_AT,
+                                    factor=BURST_FACTOR,
+                                    duration=BURST_FOR)
+    trace = RequestTrace.build(arrivals, seed=seed + 5,
+                               prompt_len=(4, 16), new_tokens=(2, 8))
+    plan = ServingFaultPlan.replica_death(N_REPLICAS, 3, DEATH_TICK)
+    clock = VirtualClock()
+    sim = Simulation(clock=clock, log=EventLog(keep_lines=False))
+    replicas = [SimReplica(f"replica-{i}", capacity=8, max_len=64,
+                           prefill_chunk=16, prefill_budget=4,
+                           max_queue=128, clock=clock, cost=SERVE_COST)
+                for i in range(N_REPLICAS)]
+    fleet = SimServingFleet(replicas, cost=SERVE_COST, sim=sim,
+                            fault_plan=plan,
+                            router_kwargs=dict(seed=seed + 11),
+                            poll_every=25)
+    s = fleet.run(trace)
+    s["requests"] = n_requests
+    s["ttft_p50"] = s.pop("ttft_p50_vs")
+    s["ttft_p99"] = s.pop("ttft_p99_vs")
+    s["latency_p50"] = s.pop("latency_p50_vs")
+    s["tokens_per_sec"] = s.pop("tokens_per_vsec")
+    return s
+
+
+# ------------------------------------------------------------------ #
+# CLI
+# ------------------------------------------------------------------ #
+DEFAULT_BASELINE = "benchmarks/fleet_sim_r18.json"
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--train-steps", type=int, default=64)
+    ap.add_argument("--requests", type=int, default=1_000_000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=DEFAULT_BASELINE)
+    ap.add_argument("--compare", metavar="PREV.json",
+                    default=(DEFAULT_BASELINE
+                             if os.path.exists(DEFAULT_BASELINE)
+                             else None),
+                    help="regression gate (default: the committed "
+                         "fleet_sim_r18.json when present; pass '' "
+                         "to disable)")
+    ap.add_argument("--tolerance", type=float, default=0.02,
+                    help="gate tolerance (every headline is virtual-"
+                         "time deterministic; lost_requests is pinned "
+                         "to zero tolerance regardless)")
+    args = ap.parse_args(argv)
+    if args.compare == "":
+        args.compare = None
+    return args
+
+
+def _finitize(obj):
+    """Strict JSON: non-finite floats become ``None``."""
+    if isinstance(obj, dict):
+        return {k: _finitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_finitize(v) for v in obj]
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    return obj
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    train = training_scenario(args.train_steps, args.seed)
+    serve = serving_scenario(args.requests, args.seed)
+
+    checks = {
+        # the congested DCN link is detected, routed around, committed
+        "train_triggered_degraded": "degraded" in train[
+            "trigger_reasons"],
+        "train_swapped": train["swap_step"] is not None,
+        "train_committed": train["commit_step"] is not None,
+        "train_step_time_improves": (
+            train["p50_adapted_s"] < 0.9 * train["p50_congested_s"]),
+        # the preempted rank round-trips through the real controller
+        "train_membership_roundtrip": all(
+            train["event_counts"].get(k, 0) >= 1
+            for k in ("membership_die", "membership_admit",
+                      "membership_promote")),
+        "train_membership_triggered": "membership" in train[
+            "trigger_reasons"],
+        "train_rejoined": train["dead_at_end"] == 0,
+        "train_weights_rerendered": train["weight_renders"] >= 3,
+        # the persistent straggler is named by the real detector
+        "train_straggler_named": train["flagged_stragglers"] == [33],
+        # serving: token-exact failover, flash-crowd backpressure
+        "serve_failover_happened": serve["failovers"] > 0,
+        "serve_no_request_unaccounted": (
+            serve["completed"] + serve["lost_requests"]
+            == serve["requests"]),
+        "serve_burst_sheds_load": 0 < serve["lost_requests"] < (
+            0.05 * serve["requests"]),
+        "headlines_finite": all(
+            isinstance(v, float) and math.isfinite(v)
+            for v in (train["p50_adapted_s"],
+                      train["detect_to_swap_virtual_s"],
+                      serve["tokens_per_sec"])),
+    }
+    for k, ok in checks.items():
+        print(f"[check] {k}: {'OK' if ok else 'FAILED'}")
+
+    out = {
+        "sim_training_detail": train,
+        "sim_serving_detail": {k: v for k, v in serve.items()
+                               if k != "event_digest"},
+        "serving_event_digest": serve["event_digest"],
+        # the headline sections the bench gate reads
+        "sim_training": {
+            "p50": train["p50_adapted_s"],
+            "step_time_ratio": (train["p50_adapted_s"]
+                                / train["p50_congested_s"]),
+            "detect_to_swap_s": train["detect_to_swap_virtual_s"],
+        },
+        "sim_serving": {
+            "tokens_per_sec": serve["tokens_per_sec"],
+            "lost_requests": float(serve["lost_requests"]),
+            "ttft_p50": serve["ttft_p50"],
+        },
+        "checks": {k: bool(v) for k, v in checks.items()},
+    }
+    print(json.dumps({"checks": out["checks"],
+                      "sim_training": out["sim_training"],
+                      "sim_serving": out["sim_serving"]}))
+    if not all(checks.values()):
+        return 1
+    if args.compare:
+        from bluefog_tpu.benchutil import bench_regression_gate
+
+        ok = bench_regression_gate(
+            out, args.compare, tolerance=args.tolerance,
+            tolerances={"sim_serving.lost_requests": 0.0})
+        if not ok:
+            print(f"[bench-gate] regression: NOT writing {args.out}")
+            return 1
+    with open(args.out, "w") as fh:
+        json.dump(_finitize(out), fh, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -49,6 +49,15 @@ and by scattered tests; the lint makes them mechanical:
     (``serving.resilience.backoff_sleep``): deterministic delays keyed
     on (seed, request, attempt) are what make chaos runs replayable and
     keep retry storms from synchronizing across replicas.
+``wallclock-in-sim``
+    ``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()``
+    (and their ``_ns`` variants, however imported) under
+    ``bluefog_tpu/sim/``.  The simulator's whole contract is that the
+    same seed replays byte-equal: every timestamp must come from the
+    injected :class:`~bluefog_tpu.sim.clock.VirtualClock` (or, for
+    calibration, an injected ``timer`` argument) — one wall-clock read
+    makes event logs non-reproducible and silently couples simulated
+    dynamics to host load.
 
 Pure-syntactic by design: no imports of the scanned modules, so the
 lint runs in milliseconds and can't be confused by import-time side
@@ -528,6 +537,56 @@ class _SleepInLoopVisitor(_ScopeTracker):
 
 
 # --------------------------------------------------------------------- #
+# rule: wallclock-in-sim (bluefog_tpu/sim/)
+# --------------------------------------------------------------------- #
+
+# time-module entry points that read the host clock
+_WALLCLOCK_FNS = {
+    "time", "monotonic", "perf_counter", "process_time",
+    "time_ns", "monotonic_ns", "perf_counter_ns", "process_time_ns",
+}
+
+
+class _WallClockVisitor(_ScopeTracker):
+    """Any host-clock read under the simulator package breaks the
+    same-seed ⇒ byte-equal-event-log contract.  Both spellings are
+    caught: ``time.perf_counter()`` and a bare ``perf_counter()``
+    bound by ``from time import perf_counter [as alias]``.  Injected
+    timers (a ``timer=`` parameter the caller passes from outside the
+    package) are the sanctioned calibration seam."""
+
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self.path = path
+        self.from_imports: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for alias in node.names:
+                if alias.name in _WALLCLOCK_FNS:
+                    self.from_imports.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        name = None
+        if isinstance(f, ast.Attribute) and f.attr in _WALLCLOCK_FNS \
+                and _dotted(f) == f"time.{f.attr}":
+            name = _dotted(f)
+        elif isinstance(f, ast.Name) and f.id in self.from_imports:
+            name = f.id
+        if name:
+            self.findings.append(Finding(
+                "wallclock-in-sim", self.path, node.lineno, self.symbol,
+                f"{name}() reads the host clock inside the simulator; "
+                "virtual time must come from the injected VirtualClock "
+                "(or an injected timer= for calibration) so same-seed "
+                "runs replay byte-equal"))
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------- #
 # rule: unregistered-pytest-marker (tests/)
 # --------------------------------------------------------------------- #
 
@@ -580,13 +639,14 @@ class _MarkerVisitor(_ScopeTracker):
 def lint_file(path: str, rel: str, *, markers: Set[str],
               in_package: bool, in_benchmarks: bool,
               in_tests: bool,
-              in_serving: Optional[bool] = None) -> List[Finding]:
+              in_serving: Optional[bool] = None,
+              in_sim: Optional[bool] = None) -> List[Finding]:
     """All findings for one file.  ``rel`` is the repo-relative posix
     path recorded on the findings; the ``in_*`` flags select which rule
     families apply (set by :func:`run_lint` from the file's location).
-    ``in_serving`` defaults from ``rel`` (files under
-    ``bluefog_tpu/serving/``); pass it explicitly to force the rule on
-    a fixture."""
+    ``in_serving`` / ``in_sim`` default from ``rel`` (files under
+    ``bluefog_tpu/serving/`` / ``bluefog_tpu/sim/``); pass them
+    explicitly to force the rule on a fixture."""
     try:
         tree = ast.parse(open(path).read(), filename=path)
     except SyntaxError as e:
@@ -594,6 +654,8 @@ def lint_file(path: str, rel: str, *, markers: Set[str],
                         f"file does not parse: {e.msg}")]
     if in_serving is None:
         in_serving = rel.startswith("bluefog_tpu/serving/")
+    if in_sim is None:
+        in_sim = rel.startswith("bluefog_tpu/sim/")
     findings: List[Finding] = []
     if in_package:
         if os.path.basename(path) != "config.py":
@@ -615,6 +677,10 @@ def lint_file(path: str, rel: str, *, markers: Set[str],
         sv = _SleepInLoopVisitor(rel)
         sv.visit(tree)
         findings += sv.findings
+    if in_sim:
+        cv = _WallClockVisitor(rel)
+        cv.visit(tree)
+        findings += cv.findings
     if in_benchmarks:
         rv = _UnseededRandomVisitor(rel)
         rv.visit(tree)

@@ -1,0 +1,78 @@
+"""Fleet-scale discrete-event simulation: real control plane, virtual time.
+
+Every scale claim a real-engine bench can make tops out near the host's
+core count; this package lifts the ceiling by replacing only the DEVICE
+work with a calibrated cost model while the CONTROL decisions stay with
+the production code — the same
+:class:`~bluefog_tpu.serving.fleet.FleetRouter`,
+:class:`~bluefog_tpu.elastic.MembershipController`,
+:class:`~bluefog_tpu.observe.fleet.StragglerDetector`, and
+:class:`~bluefog_tpu.topology.TopologyControlPlane` a hardware fleet
+runs, fed through the same ``observe`` registry families they read in
+production.  TACCL's discipline applies (arXiv:2111.04867): the cost
+model is calibrated from one measured capture of the real engine, and
+the simulator itself is validated against lockstep real-engine runs at
+small n — routing decisions bit-equal, dynamics within tolerance —
+before any large-n number is quoted (tests/test_sim.py).
+
+* :mod:`~bluefog_tpu.sim.clock` — the one :class:`VirtualClock`
+  (deduplicating the benches' private copies);
+* :mod:`~bluefog_tpu.sim.engine` — seeded event heap +
+  :class:`EventLog` with a streaming SHA-256 (same seed ⇒ byte-equal
+  log, O(1) memory at a million events);
+* :mod:`~bluefog_tpu.sim.cost` — :class:`CostModel`: committed
+  constants for gated runs, ``from_engine`` calibration for validation
+  (wall time only through an injected timer — the ``wallclock-in-sim``
+  lint rule keeps this package clean of clock reads);
+* :mod:`~bluefog_tpu.sim.wire` — :class:`LinkWire`, the torus-priced
+  link-cost actor billing ``bf_edge_seconds_total`` (the control
+  plane's telemetry feed);
+* :mod:`~bluefog_tpu.sim.traces` — request traces and
+  :class:`ChurnSchedule` riding ``FaultPlan`` semantics (arrival-time
+  generators live in :mod:`bluefog_tpu.benchutil`);
+* :mod:`~bluefog_tpu.sim.serving` — :class:`SimReplica` (the serving
+  engine's exact host bookkeeping, device work costed) +
+  :class:`SimServingFleet` around the real router;
+* :mod:`~bluefog_tpu.sim.training` — :class:`SimTrainingFleet` driving
+  the real topology control plane / membership / straggler stack at
+  n=1024 and beyond.
+
+Guide: docs/simulation.md.  Headline bench: benchmarks/fleet_sim.py.
+"""
+
+from bluefog_tpu.sim.clock import VirtualClock  # noqa: F401
+from bluefog_tpu.sim.cost import CostModel, measure_step_cost  # noqa: F401
+from bluefog_tpu.sim.engine import (  # noqa: F401
+    EventLog,
+    Simulation,
+    format_event,
+)
+from bluefog_tpu.sim.serving import (  # noqa: F401
+    SimReplica,
+    SimRequest,
+    SimServingFleet,
+)
+from bluefog_tpu.sim.traces import (  # noqa: F401
+    ChurnAction,
+    ChurnSchedule,
+    RequestTrace,
+)
+from bluefog_tpu.sim.training import SimTrainingFleet  # noqa: F401
+from bluefog_tpu.sim.wire import LinkWire  # noqa: F401
+
+__all__ = [
+    "VirtualClock",
+    "EventLog",
+    "Simulation",
+    "format_event",
+    "CostModel",
+    "measure_step_cost",
+    "LinkWire",
+    "RequestTrace",
+    "ChurnAction",
+    "ChurnSchedule",
+    "SimRequest",
+    "SimReplica",
+    "SimServingFleet",
+    "SimTrainingFleet",
+]

@@ -110,6 +110,22 @@ MODULES = [
     ("bluefog_tpu.run.run", "bfrun launcher (local + multi-host)"),
     ("bluefog_tpu.utility", "broadcast/allreduce convenience helpers"),
     ("bluefog_tpu.config", "environment-variable configuration"),
+    ("bluefog_tpu.sim",
+     "discrete-event fleet simulator: real control plane, virtual time"),
+    ("bluefog_tpu.sim.clock",
+     "virtual clock: monotonic simulated seconds, no wall reads"),
+    ("bluefog_tpu.sim.engine",
+     "event heap + streaming event log (byte-stable digests)"),
+    ("bluefog_tpu.sim.cost",
+     "calibrated cost model: virtual seconds per unit of real work"),
+    ("bluefog_tpu.sim.wire",
+     "per-step virtual transport billing the telemetry registry"),
+    ("bluefog_tpu.sim.traces",
+     "request traces + membership churn schedules (seeded)"),
+    ("bluefog_tpu.sim.serving",
+     "simulated replicas + lockstep fleet around the real router"),
+    ("bluefog_tpu.sim.training",
+     "simulated training fleet driving the real control plane"),
     ("bluefog_tpu.analysis",
      "static contract checker (bfcheck): findings + baseline"),
     ("bluefog_tpu.analysis.lint",

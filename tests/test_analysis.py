@@ -431,6 +431,57 @@ def test_rule_sleep_without_backoff(tmp_path):
         """, in_serving=False, **_PKG) == []
 
 
+def test_rule_wallclock_in_sim(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import time
+        from time import perf_counter as pc
+        def stamp(log):
+            log.record(time.time(), 'tick')
+        def measure():
+            t0 = pc()
+            return time.monotonic_ns() - t0
+        """, in_sim=True, **_PKG)
+    assert [f.rule for f in fs] == ["wallclock-in-sim"] * 3
+    assert sorted(f.symbol for f in fs) == ["measure", "measure", "stamp"]
+    assert "VirtualClock" in fs[0].message
+    # the injected virtual clock and the injected calibration timer are
+    # the sanctioned seams (time.sleep is the serving rule's business)
+    assert _lint_src(tmp_path, """
+        import time
+        def step(self):
+            now = self.clock()
+            self.clock.advance(0.002)
+            return now
+        def calibrate(engine, timer):
+            t0 = timer()
+            engine.step()
+            return timer() - t0
+        def nap():
+            time.sleep(0.1)
+        """, in_sim=True, **_PKG) == []
+    # outside bluefog_tpu/sim/ the rule stays dormant
+    assert _lint_src(tmp_path, """
+        import time
+        def now():
+            return time.monotonic()
+        """, in_sim=False, **_PKG) == []
+
+
+def test_sim_package_has_no_wallclock_reads():
+    """The rule is live on the real tree: every file under
+    bluefog_tpu/sim/ lints clean (virtual time only — the calibration
+    path takes its timer as an argument)."""
+    base = os.path.join(_REPO, "bluefog_tpu", "sim")
+    assert os.path.isdir(base)
+    for fn in sorted(os.listdir(base)):
+        if not fn.endswith(".py"):
+            continue
+        rel = f"bluefog_tpu/sim/{fn}"
+        fs = L.lint_file(os.path.join(base, fn), rel,
+                         markers=set(), **_PKG)
+        assert [f for f in fs if f.rule == "wallclock-in-sim"] == [], rel
+
+
 def test_registered_markers_include_analysis():
     marks = L.registered_markers(_REPO)
     assert "analysis" in marks and "perf" in marks

@@ -402,6 +402,80 @@ def test_compressed_audit_baseline_is_committed_and_defended():
     assert "compressed.dcn_bytes_per_step" in head
 
 
+# --------------------------------------------------------------------- #
+# fleet-sim baseline (ISSUE 17, simulator): the n=1024 virtual-time
+# scenarios join the gate flow — every headline is deterministic (no
+# wall-clock measurement feeds any gated figure), and
+# sim_serving.lost_requests is gated at ZERO tolerance: the trace is
+# seeded, so any drift in the loss count is a routing-behavior change,
+# not noise
+# --------------------------------------------------------------------- #
+@pytest.mark.sim
+def test_fleet_sim_defaults_and_baseline():
+    """fleet_sim.py gates against the committed r18 artifact by
+    default; ``--compare ''`` opts out; the committed record passed
+    every machine-checked claim: congested-link trigger->swap->commit
+    at n=1024, the preempted rank round-tripped through the real
+    membership controller, the straggler named, token-exact replica
+    failover mid-million-request trace, and flash-crowd backpressure
+    bounded."""
+    fs = _load_bench_module("fleet_sim")
+    args = fs.parse_args([])
+    assert args.compare == fs.DEFAULT_BASELINE
+    assert os.path.exists(args.compare)
+    assert fs.parse_args(["--compare", ""]).compare is None
+    assert fs.parse_args(["--compare", "x.json"]).compare == "x.json"
+    base = _load(os.path.join("benchmarks", "fleet_sim_r18.json"))
+    assert all(base["checks"].values())
+    assert base["sim_training"]["step_time_ratio"] < 0.9
+    assert base["sim_training"]["detect_to_swap_s"] > 0
+    assert base["sim_serving"]["lost_requests"] >= 0
+    assert base["sim_serving"]["tokens_per_sec"] > 0
+    detail = base["sim_training_detail"]
+    assert detail["ranks"] == 1024
+    assert detail["flagged_stragglers"] == [33]
+    assert detail["dead_at_end"] == 0
+    serve = base["sim_serving_detail"]
+    assert serve["requests"] == 1_000_000
+    assert serve["failovers"] > 0
+    assert serve["completed"] + serve["lost_requests"] == serve["requests"]
+    from bluefog_tpu.benchutil import bench_headline
+
+    head = bench_headline(base)
+    assert "sim_training.step_time_ratio" in head
+    assert "sim_training.detect_to_swap_s" in head
+    assert "sim_serving.tokens_per_sec" in head
+    assert "sim_serving.lost_requests" in head
+
+
+@pytest.mark.sim
+def test_gate_catches_sim_regression(capsys):
+    """A simulator change that slows detection, stops adapting, or
+    strands requests fails the gate: detect_to_swap_s and
+    step_time_ratio are lower-is-better, and lost_requests is pinned at
+    zero tolerance — even a single extra lost request regresses."""
+    from bluefog_tpu.benchutil import bench_compare
+
+    base = _load(os.path.join("benchmarks", "fleet_sim_r18.json"))
+    regressed = copy.deepcopy(base)
+    regressed["sim_training"]["step_time_ratio"] = 1.0
+    regressed["sim_training"]["detect_to_swap_s"] *= 3.0
+    regressed["sim_serving"]["lost_requests"] += 1
+    ok, rows = bench_compare(
+        regressed, base, tolerance=0.02,
+        tolerances={"sim_serving.lost_requests": 0.0})
+    assert ok is False
+    bad = {r["name"] for r in rows if r["regressed"]}
+    assert "sim_training.step_time_ratio" in bad
+    assert "sim_training.detect_to_swap_s" in bad
+    assert "sim_serving.lost_requests" in bad
+    # ... and the committed record gates clean against itself
+    ok2, _ = bench_compare(base, base,
+                           tolerances={
+                               "sim_serving.lost_requests": 0.0})
+    assert ok2 is True
+
+
 @pytest.mark.hier
 def test_gate_catches_compressed_wire_regression(capsys):
     """A change that doubles the compressed wire (e.g. shipping dense

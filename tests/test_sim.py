@@ -1,0 +1,650 @@
+"""Discrete-event fleet simulator (bluefog_tpu/sim/): the validation
+contract behind every large-n number the simulator quotes.
+
+Three layers of evidence, per docs/simulation.md:
+
+1. **Determinism** — same seed ⇒ byte-equal event logs (streaming
+   SHA-256 digests match line-for-line), the event heap is a pure
+   function of the schedule calls, and the arrival generators are
+   seeded property-tested pure functions (rate integrals match
+   expectation, modulation shows up where it should).
+2. **Lockstep agreement with the real engines** — a 3-replica
+   simulated serving fleet and a 3-replica REAL ``ServingEngine``
+   fleet, driven through the same ``FleetRouter`` on the same virtual
+   clock and trace, make BIT-EQUAL routing decisions and agree exactly
+   on ticks, tokens, TTFTs, and makespan; an n=8 simulated training
+   fleet reproduces the real ``run_resilient`` control loop's
+   trigger/swap decisions step-for-step against the same telemetry.
+3. **Scale smoke** — the real ``TopologyControlPlane`` +
+   ``MembershipController`` close the loop at n=1024 inside the tier-1
+   budget, with churn round-tripping dead → joining → live through the
+   real controller.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from bluefog_tpu.benchutil import (diurnal_arrivals, flash_crowd_arrivals,
+                                   poisson_arrivals)
+from bluefog_tpu.observe import MetricsRegistry
+from bluefog_tpu.sim import (ChurnAction, ChurnSchedule, CostModel,
+                             EventLog, LinkWire, RequestTrace, SimReplica,
+                             SimRequest, SimServingFleet, SimTrainingFleet,
+                             Simulation, VirtualClock, format_event,
+                             measure_step_cost)
+
+pytestmark = pytest.mark.sim
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ #
+# clock + event engine determinism
+# ------------------------------------------------------------------ #
+def test_virtual_clock_semantics():
+    c = VirtualClock()
+    assert c() == 0.0
+    c.advance(1.5)
+    assert c() == c.t == 1.5
+    c.jump_to(1.0)          # jump never rewinds
+    assert c.t == 1.5
+    c.jump_to(2.0)
+    assert c.t == 2.0
+    with pytest.raises(ValueError):
+        c.advance(-0.1)
+
+
+def test_event_heap_fires_in_time_then_insertion_order():
+    sim = Simulation(seed=0)
+    fired = []
+    sim.at(2.0, "b", lambda s, t: fired.append("b"))
+    sim.at(1.0, "a", lambda s, t: fired.append("a"))
+    sim.at(2.0, "c", lambda s, t: fired.append("c"))  # tie with b
+    n = sim.run()
+    assert n == 3 and fired == ["a", "b", "c"]
+    assert sim.clock.t == 2.0
+    # inclusive `until` + clock lands on the bound even with a dry heap
+    sim.at(3.0, "d")
+    sim.run(until=5.0)
+    assert sim.clock.t == 5.0 and sim.pending == 0
+    with pytest.raises(ValueError):
+        sim.at(4.0, "past")  # behind the clock
+
+
+def test_event_log_byte_equal_same_seed():
+    def build(seed):
+        sim = Simulation(seed=seed)
+
+        def emit(s, t):
+            s.log.record(t, "draw", "actor-0",
+                         value=float(s.rng.rand()))
+            if s.pending < 8:
+                s.after(float(s.rng.exponential(0.5)), "tick", emit)
+
+        sim.at(0.0, "tick", emit)
+        sim.run(until=10.0)
+        return sim
+
+    a, b, c = build(7), build(7), build(8)
+    assert a.log.lines == b.log.lines
+    assert a.log.digest() == b.log.digest()
+    assert a.log.n == b.log.n > 0
+    assert a.log.digest() != c.log.digest()  # seed reaches the bytes
+
+
+def test_event_log_digest_only_mode_matches_kept_lines():
+    kept, bare = EventLog(keep_lines=True), EventLog(keep_lines=False)
+    for log in (kept, bare):
+        log.record(0.25, "route", "replica-1", rid=3, ok=True)
+        log.record(1.0, "lost", rid=4)
+    assert bare.lines is None and bare.n == kept.n == 2
+    assert bare.digest() == kept.digest()
+    assert kept.lines[0] == format_event(0.25, "route", "replica-1",
+                                         rid=3, ok=True)
+    # byte-stable value renderings: bool as 1/0, float via %.9g
+    assert "ok=1" in kept.lines[0] and "0.250000000" in kept.lines[0]
+
+
+# ------------------------------------------------------------------ #
+# arrival generators: seeded property tests
+# ------------------------------------------------------------------ #
+@pytest.mark.parametrize("gen,kw", [
+    (poisson_arrivals, {}),
+    (diurnal_arrivals, dict(period=40.0, depth=0.6)),
+    (flash_crowd_arrivals, dict(at=10.0, factor=5.0, duration=4.0)),
+])
+def test_arrival_generators_seeded_and_monotone(gen, kw):
+    a = gen(50.0, 2000, 3, **kw)
+    b = gen(50.0, 2000, 3, **kw)
+    c = gen(50.0, 2000, 4, **kw)
+    assert np.array_equal(a, b)            # pure function of the seed
+    assert not np.array_equal(a, c)
+    assert a[0] == 0.0
+    assert np.all(np.diff(a) >= 0.0)       # nondecreasing times
+    assert np.isfinite(a).all()
+
+
+def test_diurnal_rate_integral_and_modulation():
+    rate, period, depth = 200.0, 20.0, 0.8
+    t = diurnal_arrivals(rate, 8000, seed=1, period=period, depth=depth)
+    horizon = t[-1]
+    w = 2.0 * np.pi / period
+    amp = rate * depth / w
+    expected = rate * horizon + amp * (1.0 - np.cos(w * horizon))
+    assert abs(len(t) - expected) / expected < 0.05
+    # peak quarters of the cycle (sin > 0 rising) densely beat troughs
+    phase = np.mod(t, period) / period
+    peak = np.sum(phase < 0.5)
+    trough = np.sum(phase >= 0.5)
+    assert peak > 1.5 * trough
+    with pytest.raises(ValueError):
+        diurnal_arrivals(rate, 10, depth=1.0)  # depth must be < 1
+
+
+def test_flash_crowd_rate_integral():
+    rate, at, factor, dur = 100.0, 5.0, 6.0, 2.0
+    t = flash_crowd_arrivals(rate, 4000, seed=2, at=at, factor=factor,
+                             duration=dur)
+    pre = np.sum(t < at)
+    burst = np.sum((t >= at) & (t < at + dur))
+    assert abs(pre - rate * at) / (rate * at) < 0.15
+    assert abs(burst - rate * factor * dur) / (rate * factor * dur) < 0.15
+    # burst density is ~factor times the baseline density
+    base_density = pre / at
+    burst_density = burst / dur
+    assert burst_density / base_density > factor * 0.7
+
+
+# ------------------------------------------------------------------ #
+# traces + churn schedules
+# ------------------------------------------------------------------ #
+def test_request_trace_build_deterministic():
+    arr = poisson_arrivals(100.0, 64, 0)
+    a = RequestTrace.build(arr, seed=5, prompt_len=(2, 9),
+                           new_tokens=(1, 7), deadline_slack=0.5)
+    b = RequestTrace.build(arr, seed=5, prompt_len=(2, 9),
+                           new_tokens=(1, 7), deadline_slack=0.5)
+    assert np.array_equal(a.prompt_lens, b.prompt_lens)
+    assert np.array_equal(a.budgets, b.budgets)
+    assert a.n == 64
+    assert (a.prompt_lens >= 2).all() and (a.prompt_lens <= 9).all()
+    assert (a.budgets >= 1).all() and (a.budgets <= 7).all()
+    assert np.allclose(a.deadlines, arr + 0.5)
+
+
+def test_churn_schedule_from_fault_plan():
+    from bluefog_tpu.resilience import FaultPlan
+
+    # rank 3 preempted over [4, 10): dies at 4, rejoinable from 10
+    plan = FaultPlan.preempt(8, 3, 4, 6)
+    sched = ChurnSchedule.from_fault_plan(plan, 40, admit_after=2,
+                                          promote_after=5)
+    assert sched.ranks == [3]
+    assert sched.at(4) == [ChurnAction(4, 3, "die")]
+    assert sched.at(12) == [ChurnAction(12, 3, "admit")]
+    assert sched.at(17) == [ChurnAction(17, 3, "promote")]
+    assert len(sched.actions) == 3
+    with pytest.raises(ValueError):
+        ChurnAction(0, 0, "resurrect")
+
+
+# ------------------------------------------------------------------ #
+# cost model + calibration seam
+# ------------------------------------------------------------------ #
+def test_cost_model_validation_and_arithmetic():
+    cm = CostModel(step_s=2e-3, gossip_round_s=1e-4, wire_unit_s=1e-3)
+    assert cm.poll_s(3) == pytest.approx(3e-4)
+    assert cm.wire_s(2.5) == pytest.approx(2.5e-3)
+    with pytest.raises(ValueError):
+        CostModel(step_s=-1.0)
+
+
+def test_measure_step_cost_requires_injected_timer():
+    class _Eng:
+        pass
+
+    with pytest.raises(ValueError):
+        measure_step_cost(_Eng(), [], timer=None)
+
+
+# ------------------------------------------------------------------ #
+# serving: sim fleet determinism + failover semantics (no jax needed)
+# ------------------------------------------------------------------ #
+_COST = CostModel(step_s=2e-3, gossip_round_s=0.0)
+
+
+def _sim_fleet(trace, *, n_rep=3, fault_plan=None, seed=11,
+               keep_lines=True, capacity=4, max_queue=64):
+    clock = VirtualClock()
+    reps = [SimReplica(f"replica-{i}", capacity=capacity, max_len=64,
+                       prefill_chunk=8, max_queue=max_queue,
+                       clock=clock, cost=_COST)
+            for i in range(n_rep)]
+    sim = Simulation(clock=clock,
+                     log=EventLog(keep_lines=keep_lines))
+    fleet = SimServingFleet(reps, cost=_COST, sim=sim,
+                            fault_plan=fault_plan,
+                            router_kwargs=dict(seed=seed))
+    return fleet, fleet.run(trace)
+
+
+def _trace(n=160, rate=400.0, seed=3):
+    return RequestTrace.build(poisson_arrivals(rate, n, seed),
+                              seed=seed + 1, prompt_len=(2, 12),
+                              new_tokens=(2, 10))
+
+
+def test_sim_serving_fleet_same_seed_byte_equal():
+    tr = _trace()
+    _, a = _sim_fleet(tr)
+    _, b = _sim_fleet(tr)
+    assert a == b                       # the whole summary, digest incl.
+    assert a["event_digest"] == b["event_digest"]
+    assert a["completed"] == tr.n and a["lost_requests"] == 0
+    _, c = _sim_fleet(_trace(seed=4))
+    assert c["event_digest"] != a["event_digest"]
+
+
+def test_sim_serving_replica_death_token_exact_failover():
+    from bluefog_tpu.resilience import ServingFaultPlan
+
+    tr = _trace(n=120, rate=2000.0)
+    plan = ServingFaultPlan.replica_death(3, 1, 5)
+    fleet, s = _sim_fleet(tr, fault_plan=plan)
+    assert fleet.replicas[1].dead
+    assert s["failovers"] > 0
+    assert s["lost_requests"] == 0      # zero tolerance: rerouted, not lost
+    assert s["completed"] == tr.n
+    # every emitted token survived the handoff (budgets all completed)
+    assert s["tokens_total"] == float(tr.budgets.sum())
+    _, s2 = _sim_fleet(tr, fault_plan=plan)
+    assert s2["event_digest"] == s["event_digest"]
+
+
+def test_sim_serving_backpressure_loses_at_saturation():
+    # one tiny replica, a queue of 4, a flood: losses are deterministic
+    tr = _trace(n=80, rate=1e6)        # all arrive at t~0
+    fleet, s = _sim_fleet(tr, n_rep=1, capacity=1, max_queue=4)
+    assert s["lost_requests"] > 0
+    assert s["lost_requests"] + s["completed"] == tr.n
+    _, s2 = _sim_fleet(tr, n_rep=1, capacity=1, max_queue=4)
+    assert s2["lost_requests"] == s["lost_requests"]
+
+
+# ------------------------------------------------------------------ #
+# serving: sim vs REAL lockstep at 3 replicas — bit-equal routing
+# ------------------------------------------------------------------ #
+def _real_fleet_run(trace, *, n_rep, step_s, seed):
+    """The real-engine mirror of ``SimServingFleet.run``: real
+    ``ServingEngine`` replicas on one shared virtual clock, the same
+    one-poll-per-tick router batch idiom, the same idle jump."""
+    import jax
+    import jax.numpy as jnp
+
+    from bluefog_tpu import models
+    from bluefog_tpu.serving import FleetRouter, Request, ServingEngine
+
+    cfg = models.LlamaConfig.tiny(dtype=jnp.float32)
+    variables = models.Llama(cfg).init(jax.random.PRNGKey(1),
+                                       jnp.zeros((2, 4), jnp.int32))
+    clock = VirtualClock()
+    regs = [MetricsRegistry() for _ in range(n_rep)]
+    engines = [ServingEngine(variables, cfg, capacity=4, max_len=64,
+                             prefill_chunk=8, max_queue=64,
+                             clock=clock, registry=regs[i])
+               for i in range(n_rep)]
+    router = FleetRouter(engines, registries=regs, clock=clock,
+                         sleep=clock.advance, seed=seed)
+    rs = np.random.RandomState(99)     # token VALUES: control-irrelevant
+    reqs = [Request(rs.randint(0, 256,
+                               (int(trace.prompt_lens[k]),)).astype(
+                                   np.int32),
+                    int(trace.budgets[k]), rid=k)
+            for k in range(trace.n)]
+    dead = np.zeros(n_rep, bool)
+    route, ticks, i = [], 0, 0
+    arr = trace.arrivals
+    while True:
+        if i < trace.n and arr[i] <= clock.t:
+            snap = router.poll(dead_mask=dead)
+            while i < trace.n and arr[i] <= clock.t:
+                j, _ = router.submit(reqs[i], snapshot=snap,
+                                     dead_mask=dead)
+                route.append(j)
+                i += 1
+        busy = any(e._running or e._admitting or e.scheduler.queue_depth
+                   for e in engines)
+        if not busy:
+            if i >= trace.n:
+                break
+            clock.jump_to(float(arr[i]))
+            continue
+        for e in engines:
+            e.step()
+        clock.advance(step_s)
+        ticks += 1
+        assert ticks < 10_000, "real fleet did not converge"
+    ttfts = sorted(t for e in engines for t in e.metrics.ttfts())
+    return dict(route=route, ticks=ticks, makespan=clock.t,
+                tokens={r.rid: len(r.tokens) for r in reqs},
+                states={r.rid: r.state for r in reqs},
+                ttfts=ttfts)
+
+
+_ROUTE_RE = re.compile(r" route replica-(\d+) rid=(\d+)$")
+
+
+def test_sim_vs_real_serving_lockstep_bit_equal_routing():
+    """The acceptance property of the whole serving sim: with the same
+    clock, trace, and router seed, the simulated fleet and a lockstep
+    REAL 3-replica fleet agree bit-for-bit on every routing decision —
+    and exactly on ticks, makespan, per-request token counts, and the
+    virtual-time TTFT distribution."""
+    tr = _trace(n=48, rate=900.0, seed=6)
+    real = _real_fleet_run(tr, n_rep=3, step_s=_COST.step_s, seed=11)
+
+    fleet, s = _sim_fleet(tr, n_rep=3, seed=11)
+    sim_route = {}
+    for line in fleet.log.lines:
+        m = _ROUTE_RE.search(line)
+        if m:
+            sim_route[int(m.group(2))] = int(m.group(1))
+    assert [sim_route[k] for k in range(tr.n)] == real["route"]
+    assert s["ticks"] == real["ticks"]
+    assert s["virtual_seconds"] == pytest.approx(real["makespan"],
+                                                 abs=1e-12)
+    assert s["completed"] == tr.n
+    assert all(st == "completed" for st in real["states"].values())
+    # token-for-token agreement via the totals + terminal states
+    assert s["tokens_total"] == float(sum(real["tokens"].values()))
+    sim_ttfts = sorted(
+        v for rep in fleet.replicas
+        for name, kind, _h, _l, m in rep.registry.collect()
+        if name == "bf_serving_ttft_seconds" and kind == "histogram"
+        for v in m.window_values)
+    assert np.allclose(sim_ttfts, real["ttfts"], atol=1e-12)
+
+
+# ------------------------------------------------------------------ #
+# training: sim vs REAL run_resilient at n=8 — same control decisions
+# ------------------------------------------------------------------ #
+def _load_bench_module(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "benchmarks", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _control_and_wire(bench, plan, *, registry):
+    """The r16 congestion scenario's control plane + wire, shared
+    between the real and simulated runs (one construction per run —
+    the plane is stateful)."""
+    from bluefog_tpu.topology import TopologyControlPlane
+
+    pod = bench.make_pod()
+    static = [bench.dcn_round(+1), bench.ici_round(),
+              bench.dcn_round(+1), bench.dcn_round(-1)]
+    control = TopologyControlPlane(
+        pod, bench.rich_carrier(), registry=registry, window=8,
+        patience=2, degrade_ratio=1.3, margin=0.05, cooldown=8,
+        probation=6, rollback_tolerance=2.0, contention=3.0,
+        synchronous=True, initial=static)
+    wire = LinkWire(
+        pod, registry,
+        schedule_fn=lambda s: control.active_schedule()[s % bench.ROUNDS],
+        dead_fn=lambda: np.zeros(bench.N, bool),
+        congestion_fn=plan.congested_links,
+        wire_unit=bench.WIRE_UNIT, period=bench.ROUNDS)
+    return control, wire
+
+
+def test_sim_vs_real_training_control_decisions_agree():
+    """The simulated training fleet must reproduce the REAL
+    ``run_resilient`` closed loop's decisions on the same telemetry:
+    same trigger step, same swap step, same chosen candidate, same
+    scored costs — the control plane cannot tell the difference."""
+    import tempfile
+
+    import jax
+    from jax.sharding import Mesh
+
+    from bluefog_tpu import resilience as R
+    from bluefog_tpu.checkpoint import Checkpointer
+    from bluefog_tpu.optim import functional as F
+
+    bench = _load_bench_module("chaos_adaptive_topology")
+    steps, congest_at = 28, 8
+
+    def make_plan():
+        plan = R.FaultPlan.congest_link(bench.N, 0, 2, 4.0,
+                                        start=congest_at, duration=steps)
+        return plan.merged(R.FaultPlan.congest_link(
+            bench.N, 1, 3, 4.0, start=congest_at, duration=steps))
+
+    # -- the REAL loop: jax training under run_resilient -------------- #
+    reg = MetricsRegistry()
+    plan = make_plan()
+    control, wire = _control_and_wire(bench, plan, registry=reg)
+    mesh = Mesh(np.array(jax.devices()[:bench.N]), ("bf",))
+    dim, width, xs, ys, loss_fn, opt = bench._training_setup(0)
+    det = R.FailureDetector(bench.N)
+    wire.dead_fn = det.dead_mask
+
+    def batch_fn(step):
+        wire.bill(step)
+        return (xs[step % 64], ys[step % 64])
+
+    step_g = F.build_train_step(loss_fn, opt, mesh, comm_mode="atc",
+                                schedule=control.carrier,
+                                guard=F.GuardConfig())
+    params, opt_state = bench._fresh(mesh, dim, width, opt)
+    with tempfile.TemporaryDirectory() as d:
+        ck = Checkpointer(d)
+        res = R.run_resilient(
+            step_g, params, opt_state, batch_fn, steps=steps,
+            checkpointer=ck, mesh=mesh, schedule=control.carrier,
+            fault_plan=plan, detector=det, checkpoint_every=0,
+            sleep=lambda s: None, control=control)
+        ck.close()
+    real = [(e.kind, e.step, e.detail) for e in res.events
+            if e.kind.startswith("topology_")]
+    real_charges = list(wire.charges)
+
+    # -- the SIM loop: same plane construction, virtual time ---------- #
+    reg2 = MetricsRegistry()
+    plan2 = make_plan()
+    control2, wire2 = _control_and_wire(bench, plan2, registry=reg2)
+    fleet = SimTrainingFleet(control=control2, wire=wire2,
+                             fault_plan=plan2,
+                             cost=CostModel(train_step_s=1e-3,
+                                            wire_unit_s=bench.WIRE_UNIT))
+    fleet.run(steps)
+    sim = [(k, s, d) for k, s, d in fleet.events
+           if k.startswith("topology_")]
+
+    # identical telemetry ⇒ identical decisions, step for step
+    assert [(k, s) for k, s, _ in sim] == [(k, s) for k, s, _ in real]
+    assert any(k == "topology_swap" for k, _, _ in sim)
+    sim_swap = next(d for k, _, d in sim if k == "topology_swap")
+    real_swap = next(d for k, _, d in real if k == "topology_swap")
+    assert sim_swap["schedule"] == real_swap["schedule"]
+    assert sim_swap["cost_to_consensus"] == pytest.approx(
+        real_swap["cost_to_consensus"])
+    assert sim_swap["incumbent"] == pytest.approx(real_swap["incumbent"])
+    assert control2.active_name() == control.active_name()
+    # and identical wire dynamics: the same per-step bottleneck charges
+    assert wire2.charges == real_charges
+
+
+# ------------------------------------------------------------------ #
+# training: membership churn round-trip through the real controller
+# ------------------------------------------------------------------ #
+def _menu_candidates(shifts):
+    """A tiny explicit candidate menu (``candidates_fn`` shape): ring
+    and exp2-style shift schedules expressed over the carrier."""
+    from bluefog_tpu.topology import DynamicTopology
+
+    def gen(pod, dead):
+        n = pod.size
+        out = []
+        for name, ss in shifts:
+            rounds = []
+            for s in ss:
+                ew = {(i, (i + s) % n): 1.0 for i in range(n)}
+                rounds.append(DynamicTopology.from_edges(
+                    n, {k: 0.5 for k in ew}, [0.5] * n))
+            out.append((name, rounds))
+        return out
+
+    return gen
+
+
+def test_membership_churn_roundtrip_n8():
+    """die → admit → promote through the real MembershipController:
+    the dead mask round-trips, every transition re-renders weights
+    through the real healing/bootstrap paths, and the run digests
+    deterministically."""
+    from bluefog_tpu.elastic import MembershipController
+    from bluefog_tpu.resilience import FaultPlan
+    from bluefog_tpu.topology import TopologyControlPlane
+
+    bench = _load_bench_module("chaos_adaptive_topology")
+
+    def build():
+        reg = MetricsRegistry()
+        pod = bench.make_pod()
+        static = [bench.dcn_round(+1), bench.ici_round(),
+                  bench.dcn_round(+1), bench.dcn_round(-1)]
+        control = TopologyControlPlane(
+            pod, bench.rich_carrier(), registry=reg, window=8,
+            patience=2, degrade_ratio=1.3, margin=0.05, cooldown=8,
+            probation=6, synchronous=True, initial=static)
+        membership = MembershipController(control.active_schedule(),
+                                          bootstrap_rounds=4)
+        plan = FaultPlan.preempt(bench.N, 5, 6, 8)
+        churn = ChurnSchedule.from_fault_plan(plan, 40, admit_after=0,
+                                              promote_after=6)
+        wire = LinkWire(
+            pod, reg,
+            schedule_fn=lambda s: control.active_schedule()[
+                s % bench.ROUNDS],
+            dead_fn=lambda: fleets[-1].dead_mask(),
+            wire_unit=bench.WIRE_UNIT, period=bench.ROUNDS)
+        fleet = SimTrainingFleet(
+            control=control, wire=wire, membership=membership,
+            churn=churn, cost=CostModel(train_step_s=1e-3))
+        fleets.append(fleet)
+        return fleet
+
+    fleets = []
+    fleet = build()
+    # before the preempt: everyone live
+    fleet.run(6)
+    assert fleet.dead_mask().sum() == 0
+    # dies at 6 (structural — immediate)
+    fleet.run(1)
+    assert fleet.dead_mask()[5] and fleet.dead_mask().sum() == 1
+    renders_at_death = fleet.weight_renders
+    assert renders_at_death >= 1
+    # rejoin window: admit at 14, promote at 20; run through both
+    fleet.run(33 - 7)
+    assert fleet.dead_mask().sum() == 0       # back to fully live
+    kinds = {k for k, _, _ in fleet.events}
+    assert {"membership_die", "membership_admit",
+            "membership_promote"} <= kinds
+    assert fleet.weight_renders > renders_at_death
+    s1 = fleet.summary()
+
+    fleet2 = build()
+    fleet2.run(33)
+    assert fleet2.summary()["event_digest"] == s1["event_digest"]
+
+
+def test_training_straggler_detected_by_real_detector():
+    from bluefog_tpu.observe.fleet import StragglerDetector
+    from bluefog_tpu.resilience import FaultPlan
+    from bluefog_tpu.topology import TopologyControlPlane
+
+    bench = _load_bench_module("chaos_adaptive_topology")
+    reg = MetricsRegistry()
+    pod = bench.make_pod()
+    control = TopologyControlPlane(
+        pod, bench.rich_carrier(), registry=reg, window=8, patience=3,
+        degrade_ratio=1.5, cooldown=8, synchronous=True,
+        initial=[bench.ici_round()] * bench.ROUNDS)
+    plan = FaultPlan.persistent_straggler(bench.N, 5, 4, 0.25)
+    fleet = SimTrainingFleet(
+        control=control, fault_plan=plan,
+        straggler=StragglerDetector(bench.N, registry=reg),
+        cost=CostModel(train_step_s=1e-3))
+    fleet.run(16)
+    flagged = [d["rank"] for k, _, d in fleet.events
+               if k == "straggler"]
+    assert flagged == [5]
+    # lockstep pays the straggler's price: steps after onset are slower
+    assert dict(fleet.step_times)[10] >= 0.25
+
+
+# ------------------------------------------------------------------ #
+# scale smoke: n=1024 through the real control plane, tier-1 budget
+# ------------------------------------------------------------------ #
+def test_n1024_control_plane_smoke():
+    """1024 ranks (128 machines x 8 chips): a congested DCN link must
+    drive the real windowed-detection → menu-synthesis → hot-swap loop
+    in virtual time, deterministically, in seconds of wall time."""
+    from bluefog_tpu.resilience import FaultPlan
+    from bluefog_tpu.topology import (DynamicTopology, PodSpec,
+                                      TopologyControlPlane)
+
+    n, machines, local = 1024, 128, 8
+    shifts = (1, 8, 64, 512)
+
+    def carrier():
+        w = 1.0 / (len(shifts) + 1)
+        ew = {(i, (i + s) % n): w for s in shifts for i in range(n)}
+        return [DynamicTopology.from_edges(n, ew, [w] * n)] * 2
+
+    def shift_round(s):
+        ew = {(i, (i + s) % n): 0.5 for i in range(n)}
+        return DynamicTopology.from_edges(n, ew, [0.5] * n)
+
+    def build():
+        pod = PodSpec(machines, local, ici_cost=1.0, dcn_cost=4.0)
+        reg = MetricsRegistry()
+        control = TopologyControlPlane(
+            pod, carrier(), registry=reg, window=4, patience=1,
+            degrade_ratio=1.2, margin=0.01, cooldown=6, probation=4,
+            contention=3.0, synchronous=True,
+            initial=[shift_round(8), shift_round(512)],
+            candidates_fn=_menu_candidates(
+                [("ring", (1, 1)), ("exp2", (1, 64))]))
+        plan = FaultPlan.congest_link(n, 8, 16, 6.0, start=4,
+                                      duration=32)
+        wire = LinkWire(
+            pod, reg,
+            schedule_fn=lambda s: control.active_schedule()[s % 2],
+            dead_fn=lambda: np.zeros(n, bool),
+            congestion_fn=plan.congested_links, wire_unit=1e-3,
+            period=2)
+        return SimTrainingFleet(control=control, wire=wire,
+                                cost=CostModel(train_step_s=1e-3),
+                                sim=Simulation(
+                                    log=EventLog(keep_lines=False)))
+
+    fleet = build()
+    s = fleet.run(20)
+    assert s["ranks"] == 1024
+    kinds = s["event_counts"]
+    assert kinds.get("topology_trigger", 0) >= 1
+    assert kinds.get("topology_swap", 0) >= 1
+    assert fleet.control.active_name() in ("ring", "exp2")
+    assert s["virtual_seconds"] > 0
+
+    s2 = build().run(20)
+    assert s2["event_digest"] == s["event_digest"]
