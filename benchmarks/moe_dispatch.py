@@ -1,0 +1,305 @@
+"""MoE dispatch benchmark: compiled all-to-all vs the naive lowering.
+
+Round-19 evidence for MoE expert parallelism (ISSUE 19): the a2a
+schedules synthesized by ``topology/compiler.compile_all_to_all`` must
+BEAT the naive ``lax.all_to_all`` lowering on cost-to-dispatch under
+the heterogeneous pod cost model, and the expert-sharded train step
+must survive an expert-machine kill→heal cycle with ZERO recompiles.
+Three parts, one JSON artifact (machine-checked claims, the
+``topology_compiler`` methodology):
+
+1. **Synthesis at the claim pod** (4x8, DCN links 4x ICI, n=32): compile
+   the dispatch schedule, score it against ``naive_all_to_all_cost``
+   (the single fused round every pair fights over) and the unbeatable
+   one-shot congestion bound, and price the wire —
+   ``dcn_bytes_per_step`` for the fp32 and int8 payload encodings from
+   the same ``predicted_collectives`` accounting the tier-1 HLO test
+   holds the lowering to.
+
+2. **Measured dispatch** (n=8 host devices): run the compiled
+   ``all_to_all_dispatch`` and the naive ``lax.all_to_all`` on the same
+   seeded shards — outputs must be BIT-identical (the schedule is a
+   reordering, never an approximation) — and record the wall-time
+   ratio.  On CPU the compiled schedule pays per-permute launch
+   overhead with no DCN to win back, so ``step_time_ratio`` is a
+   tracked headline, not a pass/fail claim; cost-to-dispatch is the
+   machine-checked claim.
+
+3. **Kill→heal with recompiles == 0**: drive
+   ``build_train_step(..., moe=MoEConfig(...))`` through an
+   expert-machine death and return — healed ``(route_table,
+   capacity_mask)`` are traced DATA, so the jit cache must not grow.
+
+``--compare PREV.json`` gates the headline numbers
+(``cost_to_dispatch`` and ``dcn_bytes_per_step`` lower is better,
+``compiled_advantage`` higher) via ``benchutil.bench_regression_gate``;
+the committed ``benchmarks/moe_dispatch_r19.json`` is the DEFAULT
+baseline when present, so a plain run IS the regression gate.
+
+Run (CPU, 8 host devices): python benchmarks/moe_dispatch.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bluefog_tpu.moe import (all_to_all_dispatch, capacity_mask_of,
+                             default_route_table, dispatch_plan,
+                             heal_route_table, init_moe_params,
+                             make_moe_loss, naive_all_to_all)
+from bluefog_tpu.optim import functional as F
+from bluefog_tpu.topology.compiler import (PodSpec, compile_all_to_all,
+                                           naive_all_to_all_cost,
+                                           one_shot_all_to_all_cost)
+from bluefog_tpu.topology.torus import link_loads, torus_one_peer_schedule
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "moe_dispatch_r19.json")
+
+N_LOCAL = 8                       # measured parts: 8 host devices
+CLAIM_POD = (4, 8)                # the ISSUE 19 acceptance pod, n=32
+
+
+def _dcn_bytes_per_step(schedule, pod, payload_bytes):
+    """Bytes crossing machine-axis (DCN) links in one dispatch period
+    under dimension-ordered routing — the same ``link_loads`` billing
+    the compiler scores with (axis 0 is the machine axis)."""
+    total = 0.0
+    for rnd in schedule:
+        pairs = [e for e, v in zip(rnd.edges, rnd.edge_weight_values)
+                 if v != 0.0]
+        for key, load in link_loads(pairs, pod.torus).items():
+            if key[1] == 0:
+                total += load * payload_bytes
+    return total
+
+
+def synthesis(machines, chips, dcn_cost, payload_bytes):
+    """Part 1: compile at the claim pod and price the wire."""
+    pod = PodSpec(machines, chips, dcn_cost=dcn_cost)
+    compiled = compile_all_to_all(pod)
+    naive = naive_all_to_all_cost(pod)
+    pred = compiled.predicted_collectives(payload_bytes)
+    return {
+        "machines": machines,
+        "chips_per_machine": chips,
+        "n": pod.size,
+        "dcn_cost": dcn_cost,
+        "winner": compiled.name,
+        "cost_to_dispatch": compiled.score["cost_to_dispatch"],
+        "naive_cost_to_dispatch": naive,
+        "one_shot_lower_bound": one_shot_all_to_all_cost(pod),
+        "compiled_advantage": compiled.score["compiled_advantage"],
+        "rounds": len(compiled.schedule),
+        "payload_bytes_per_permute": payload_bytes,
+        "permutes_per_period": pred["permutes_per_period"],
+        "bytes_per_period": pred["bytes_per_period"],
+        "dcn_bytes_per_step": _dcn_bytes_per_step(
+            compiled.schedule, pod, payload_bytes),
+        "dcn_bytes_per_step_int8": _dcn_bytes_per_step(
+            compiled.schedule, pod, payload_bytes / 4.0),
+        "search": compiled.search,
+        "compile_seconds": compiled.search["seconds"],
+    }
+
+
+def _median_seconds(fn, x, repeats):
+    fn(x).block_until_ready()             # compile + warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn(x).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def measured(mesh, seed, repeats):
+    """Part 2: compiled vs naive dispatch on real host devices —
+    bit-identical outputs, wall-time ratio recorded."""
+    pod = PodSpec(4, 2, dcn_cost=4.0)
+    plan = dispatch_plan(compile_all_to_all(pod).schedule)
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(N_LOCAL, N_LOCAL, 4, 64)).astype(np.float32)
+
+    def jitted(fn):
+        sm = jax.shard_map(lambda v: fn(v[0])[None], mesh=mesh,
+                           in_specs=P("bf"), out_specs=P("bf"),
+                           check_vma=False)
+        return jax.jit(sm)
+
+    ours = jitted(lambda v: all_to_all_dispatch(v, plan, "bf"))
+    ref = jitted(lambda v: naive_all_to_all(v, "bf"))
+    bit_identical = bool(
+        np.array_equal(np.asarray(ours(x)), np.asarray(ref(x))))
+    compiled_s = _median_seconds(ours, x, repeats)
+    naive_s = _median_seconds(ref, x, repeats)
+    return {
+        "n": N_LOCAL,
+        "shard_shape": list(x.shape[1:]),
+        "repeats": repeats,
+        "bit_identical_to_naive": bit_identical,
+        "compiled_dispatch_s": compiled_s,
+        "naive_dispatch_s": naive_s,
+        "step_time_ratio": compiled_s / naive_s,
+    }
+
+
+def heal_cycle(mesh, seed):
+    """Part 3: expert-machine kill→heal through the fused train step —
+    the jit cache must be flat across the whole cycle."""
+    n, experts, d = N_LOCAL, 4, 4
+    pod = PodSpec(4, 2, dcn_cost=4.0)
+    plan = dispatch_plan(compile_all_to_all(pod).schedule)
+    opt = optax.sgd(1e-2)
+    step = F.build_train_step(
+        make_moe_loss(plan, "bf", 3), opt, mesh, comm_mode="cta",
+        schedule=torus_one_peer_schedule((4, 2), "exp2"),
+        moe=F.MoEConfig(n_experts=experts, capacity=3))
+
+    sh = NamedSharding(mesh, P("bf"))
+    put = lambda t: jax.tree.map(
+        lambda v: jax.device_put(jnp.asarray(v), sh), t)
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    per_rank = [init_moe_params(k, d, d, experts) for k in keys]
+    params = jax.tree.map(lambda *xs: jnp.stack(xs), *per_rank)
+    params["router"]["w"] = jnp.broadcast_to(
+        per_rank[0]["router"]["w"][None], (n, d, experts))
+    params = put(params)
+    ostate = put(jax.tree.map(lambda *xs: jnp.stack(xs),
+                              *[opt.init(p) for p in per_rank]))
+
+    rng = np.random.default_rng(seed)
+    route = default_route_table(n, experts)
+
+    def batch(rt, cmask, s):
+        tokens = rng.normal(size=(n, 6, d)).astype(np.float32)
+        return (put(tokens), put(np.asarray(rt)),
+                put(np.broadcast_to(cmask[None], (n, n)).copy()))
+
+    cmask0 = capacity_mask_of(np.zeros(n))
+    params, ostate, loss0 = step(params, ostate, batch(route, cmask0, 0),
+                                 jnp.int32(0))
+    baseline = step.jitted._cache_size()
+    dead = np.zeros(n, bool)
+    dead[5] = True                        # kill a replica of expert 1
+    healed = heal_route_table(route, dead, experts)
+    params, ostate, _ = step(params, ostate,
+                             batch(healed, capacity_mask_of(dead), 1),
+                             jnp.int32(1))
+    params, ostate, loss2 = step(params, ostate, batch(route, cmask0, 2),
+                                 jnp.int32(2))
+    recompiles = step.jitted._cache_size() - baseline
+    return {
+        "n": n,
+        "experts": experts,
+        "killed_rank": 5,
+        "recompiles": int(recompiles),
+        "loss_first": float(jnp.mean(loss0)),
+        "loss_after_heal": float(jnp.mean(loss2)),
+    }
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dcn-cost", type=float, default=4.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--repeats", type=int, default=30)
+    ap.add_argument("--payload-bytes", type=float, default=4 * 64 * 4.0,
+                    help="bytes per permute shard (capacity x d_model "
+                         "x fp32)")
+    ap.add_argument("--compare", metavar="PREV.json",
+                    default=(DEFAULT_BASELINE
+                             if os.path.exists(DEFAULT_BASELINE)
+                             else None),
+                    help="gate the headline numbers against a prior "
+                         "artifact (default: the committed r19 record "
+                         "when present; pass '' to disable)")
+    ap.add_argument("--tolerance", type=float, default=0.05)
+    ap.add_argument("--out", default="benchmarks/moe_dispatch_r19.json")
+    args = ap.parse_args(argv)
+    if args.compare == "":
+        args.compare = None
+    return args
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    mesh = Mesh(np.array(jax.devices()[:N_LOCAL]), ("bf",))
+    out = {}
+    checks = {}
+
+    rec = synthesis(*CLAIM_POD, args.dcn_cost, args.payload_bytes)
+    out["moe"] = rec
+    print(f"[moe] compiled {rec['winner']} at "
+          f"{rec['machines']}x{rec['chips_per_machine']} "
+          f"cost_to_dispatch={rec['cost_to_dispatch']:.3f} vs "
+          f"naive={rec['naive_cost_to_dispatch']:.3f} "
+          f"(advantage {rec['compiled_advantage']:.3f}, "
+          f"{rec['rounds']} rounds, {rec['compile_seconds']:.2f}s)")
+    # THE acceptance claim: the synthesized schedule strictly beats the
+    # naive fused all-to-all on cost-to-dispatch at the 4x DCN pod
+    checks["compiled_beats_naive"] = (
+        rec["cost_to_dispatch"] < rec["naive_cost_to_dispatch"])
+    # ...without claiming the impossible: the one-shot congestion
+    # bound is a hard floor for any one-period dispatch
+    checks["respects_one_shot_bound"] = (
+        rec["cost_to_dispatch"] >= rec["one_shot_lower_bound"] - 1e-9)
+    checks["int8_wire_quarters_dcn_bytes"] = (
+        rec["dcn_bytes_per_step_int8"]
+        == rec["dcn_bytes_per_step"] / 4.0)
+    checks["synthesis_in_seconds"] = rec["compile_seconds"] < 30.0
+
+    meas = measured(mesh, args.seed, args.repeats)
+    out["measured"] = meas
+    print(f"[measured] n={meas['n']} compiled "
+          f"{meas['compiled_dispatch_s'] * 1e3:.3f}ms vs naive "
+          f"{meas['naive_dispatch_s'] * 1e3:.3f}ms "
+          f"(ratio {meas['step_time_ratio']:.2f}, bit_identical="
+          f"{meas['bit_identical_to_naive']})")
+    checks["dispatch_bit_identical"] = meas["bit_identical_to_naive"]
+
+    heal = heal_cycle(mesh, args.seed)
+    out["heal"] = heal
+    print(f"[heal] kill rank {heal['killed_rank']} -> heal: "
+          f"recompiles={heal['recompiles']} "
+          f"loss {heal['loss_first']:.4f} -> "
+          f"{heal['loss_after_heal']:.4f}")
+    checks["heal_recompiles_zero"] = heal["recompiles"] == 0
+    checks["losses_finite"] = bool(
+        np.isfinite([heal["loss_first"], heal["loss_after_heal"]]).all())
+
+    for k, ok in checks.items():
+        print(f"[check] {k}: {'OK' if ok else 'FAILED'}")
+    out["checks"] = {k: bool(v) for k, v in checks.items()}
+    print(json.dumps({"checks": out["checks"]}))
+
+    gate_ok = True
+    if args.compare:
+        from bluefog_tpu.benchutil import bench_regression_gate
+
+        # CPU wall-clock of a 3ms collective is noisy; the cost-model
+        # metrics carry the tight gate
+        gate_ok = bench_regression_gate(
+            out, args.compare, tolerance=args.tolerance,
+            tolerances={"measured.step_time_ratio": 0.5})
+    if args.out and gate_ok and all(checks.values()):
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1)
+    return 0 if (gate_ok and all(checks.values())) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -445,6 +445,15 @@ def sweep_cases() -> List[dict]:
                       health=False, compress=None, schedule="one_peer"))
     cases.append(dict(comm_mode="atc", overlap="bucketed", guard=True,
                       health=True, compress=None, schedule="one_peer"))
+    # expert-parallel MoE: route tables / capacity masks are traced
+    # communication-authority DATA (dispatch.py is _WEIGHT_AUTHORITY),
+    # and the expert subtree must stay out of the consensus epilogue
+    cases.append(dict(comm_mode="cta", overlap="none", guard=False,
+                      health=False, compress=None, topology=ring,
+                      moe=True))
+    cases.append(dict(comm_mode="atc", overlap="none", guard=True,
+                      health=True, compress=None, topology=ring,
+                      moe=True))
     mring = _machine_ring()
     for comm_mode, overlap, guard, health, compress in (
             ("cta", "none", False, False, None),
@@ -467,7 +476,8 @@ def case_id(c: dict) -> str:
         "health" if c["health"] else "nohealth",
         c["compress"] or "fp",
         "hier" if "hierarchical" in c
-        else ("sched" if "schedule" in c else "static")])
+        else ("sched" if "schedule" in c else "static")]
+        + (["moe"] if c.get("moe") else []))
 
 
 def _build_and_check(case: dict, mesh) -> List[Finding]:
@@ -482,6 +492,18 @@ def _build_and_check(case: dict, mesh) -> List[Finding]:
     guarded = c.pop("guard")
     health = c.pop("health")
     push_sum = c["comm_mode"] == "push_sum"
+    moe = c.pop("moe", False)
+    if moe:
+        import jax
+        from bluefog_tpu.moe import (dispatch_plan, init_moe_params,
+                                     make_moe_loss)
+        from bluefog_tpu.topology.compiler import PodSpec, compile_all_to_all
+
+        plan = dispatch_plan(
+            compile_all_to_all(PodSpec(4, N_RANKS // 4)).schedule)
+        base = init_moe_params(jax.random.PRNGKey(0), 4, 4, 4)
+        loss_fn = make_moe_loss(plan, "bf", 2)
+        c["moe"] = F.MoEConfig(n_experts=4, capacity=2)
     kwargs = dict(c)
     if kwargs.pop("overlap") != "none":
         kwargs.update(overlap="bucketed", overlap_buckets=3)
@@ -503,7 +525,16 @@ def _build_and_check(case: dict, mesh) -> List[Finding]:
         ostate = (ostate, F.push_sum_weights(mesh))
     if getattr(step, "mix_config", None) is not None:
         ostate = (ostate, step.init_mix_state(params))
-    batch = np.zeros((N_RANKS, 3, 4), np.float32)
+    if moe:
+        from bluefog_tpu.moe import default_route_table, capacity_mask_of
+        # rank-major route data: tokens, this-rank route rows, and the
+        # tiled liveness mask all shard over the leading rank axis
+        batch = (np.zeros((N_RANKS, 3, 4), np.float32),
+                 np.asarray(default_route_table(N_RANKS, 4)),
+                 np.broadcast_to(capacity_mask_of(np.zeros(N_RANKS))[None],
+                                 (N_RANKS, N_RANKS)).copy())
+    else:
+        batch = np.zeros((N_RANKS, 3, 4), np.float32)
     args = (params, ostate, batch, jnp.int32(0))
     if guarded:
         args = args + (step.default_comm_weights,)
@@ -556,6 +587,37 @@ def check_collective_contracts() -> List[Finding]:
             findings.append(Finding(
                 "collective-contract", "schedule[pod_1x8]", 0,
                 f"round_{i}", msg))
+
+    # the MoE dispatch wire: lower the compiled all-to-all and hold it
+    # to ITS predicted_collectives, full period and round-by-round —
+    # the same contract the mixing schedules above answer to
+    from bluefog_tpu.moe import all_to_all_dispatch, dispatch_plan
+    from bluefog_tpu.topology.compiler import compile_all_to_all
+
+    a2a = compile_all_to_all(PodSpec(4, 2))
+    shard = jnp.zeros((N_RANKS, N_RANKS, 16), jnp.float32)
+    a2a_payload = 16 * 4
+    apred = a2a.predicted_collectives(a2a_payload)
+
+    def _a2a_prog(plan):
+        def run(v):
+            return all_to_all_dispatch(v[0], plan, "bf")[None]
+        sma = jax.shard_map(run, mesh=mesh, in_specs=P("bf"),
+                            out_specs=P("bf"), check_vma=False)
+        return jax.jit(sma).lower(shard).compile().as_text()
+
+    hlo_a = _a2a_prog(dispatch_plan(a2a.schedule))
+    for msg in benchutil.verify_collective_contract(hlo_a, apred,
+                                                    a2a_payload):
+        findings.append(Finding("collective-contract", "a2a[pod_4x2]",
+                                0, "period", msg))
+    for i, rnd in enumerate(a2a.schedule):
+        hlo_ar = _a2a_prog(dispatch_plan([rnd]))
+        for msg in benchutil.verify_collective_contract(
+                hlo_ar, apred, a2a_payload, round_index=i):
+            findings.append(Finding("collective-contract",
+                                    "a2a[pod_4x2]", 0, f"round_{i}",
+                                    msg))
 
     hier = compile_topology(PodSpec(4, 2), hierarchical=True)
     hpred = hier.predicted_collectives(payload)

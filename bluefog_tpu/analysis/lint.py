@@ -108,9 +108,13 @@ BUILTIN_MARKERS = {
 }
 
 # a binding whose last path component matches this is "a comm-weight
-# table" for the bypass rule
+# table" for the bypass rule.  MoE route tables and capacity masks are
+# the same kind of communication-authority data (they steer the expert
+# all-to-all the way comm weights steer the mixing wire), so they
+# answer to the same rule.
 WEIGHT_NAME_RE = re.compile(
-    r"(^|_)(comm|class|self|recv|mix)_weights?$")
+    r"(^|_)((comm|class|self|recv|mix)_weights?"
+    r"|route_tables?|capacity_masks?)$")
 
 # sanctioned constructors: any call to one of these anywhere in the RHS
 # means the value came through the shared row-stochastic machinery
@@ -120,6 +124,7 @@ WEIGHT_HELPERS = {
     "class_recv_weights", "self_weight_vector", "self_weights_of",
     "push_sum_weights", "grow_comm_weights", "row_stochastic",
     "neighbor_weights", "hierarchical_comm_weights",
+    "default_route_table", "heal_route_table", "capacity_mask_of",
 }
 
 # the one sanctioned seam for replacing live weight operands mid-run:

@@ -884,7 +884,8 @@ _HEADLINE_LOWER = ("ttft_p50", "ttft_p99", "latency_p50", "latency_p99",
                    "makespan_s", "p99", "p50", "cost_to_consensus",
                    "post_rejoin_floor", "dcn_bytes_per_step",
                    "lost_requests", "step_time_ratio",
-                   "consensus_floor", "mean_drift", "detect_to_swap_s")
+                   "consensus_floor", "mean_drift", "detect_to_swap_s",
+                   "cost_to_dispatch")
 
 
 def bench_headline(record: dict) -> dict:
@@ -912,7 +913,7 @@ def bench_headline(record: dict) -> dict:
                     "hierarchical", "fault_free", "chaos_serving",
                     "drain", "adaptation", "congested", "shrink",
                     "rollback", "compressed", "sim_training",
-                    "sim_serving"):
+                    "sim_serving", "moe", "measured"):
         if isinstance(record.get(section), dict):
             grab(record[section], section + ".")
     return out

@@ -30,6 +30,7 @@ __all__ = [
     "hier_local_size",
     "mix_compress",
     "mix_compress_ratio",
+    "moe_capacity_factor",
     "kv_zero_on_free",
     "prefix_cache_mb",
     "replica_stale_s",
@@ -200,6 +201,20 @@ def mix_compress_ratio():
     except ValueError:
         return None
     return v if v > 0 else None
+
+
+def moe_capacity_factor() -> float:
+    """BLUEFOG_MOE_CAPACITY_FACTOR (default 1.25): default expert
+    capacity factor of :func:`bluefog_tpu.moe.layer.default_capacity`
+    — each destination rank accepts ``ceil(factor * tokens / n)``
+    tokens per source shard; batch-order overflow beyond it is dropped
+    onto the residual path (the keep mask is traced data).  Explicit
+    ``capacity=`` arguments always win over this env default."""
+    try:
+        v = float(_env("BLUEFOG_MOE_CAPACITY_FACTOR", "1.25"))
+    except ValueError:
+        return 1.25
+    return v if v > 0 else 1.25
 
 
 def kv_zero_on_free() -> bool:

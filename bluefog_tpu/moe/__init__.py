@@ -1,0 +1,45 @@
+"""Mixture-of-experts expert parallelism over the compiled all-to-all.
+
+The topology compiler synthesizes the dispatch schedule
+(:func:`bluefog_tpu.topology.compiler.compile_all_to_all`); this
+package is the workload side: :mod:`bluefog_tpu.moe.dispatch` lowers a
+schedule to the exact ``lax.ppermute`` program the compiler predicted
+(byte-for-byte — the HLO tests hold it there) and owns the traced
+``(route_table, capacity_mask)`` resilience data, and
+:mod:`bluefog_tpu.moe.layer` is a small top-1-routed expert layer with
+capacity-factor overflow as traced data.  Expert weights stay
+rank-local; everything else mixes through the ordinary
+``build_train_step(..., moe=MoEConfig(...))`` epilogue.
+"""
+
+from bluefog_tpu.moe.dispatch import (
+    DispatchPlan,
+    all_to_all_dispatch,
+    capacity_mask_of,
+    default_route_table,
+    dispatch_plan,
+    expert_owner,
+    heal_route_table,
+    naive_all_to_all,
+)
+from bluefog_tpu.moe.layer import (
+    default_capacity,
+    init_moe_params,
+    make_moe_loss,
+    moe_apply,
+)
+
+__all__ = [
+    "DispatchPlan",
+    "all_to_all_dispatch",
+    "capacity_mask_of",
+    "default_route_table",
+    "dispatch_plan",
+    "expert_owner",
+    "heal_route_table",
+    "naive_all_to_all",
+    "default_capacity",
+    "init_moe_params",
+    "make_moe_loss",
+    "moe_apply",
+]

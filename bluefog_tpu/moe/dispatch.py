@@ -1,0 +1,217 @@
+"""Expert dispatch: the compiled all-to-all on the wire.
+
+``compile_all_to_all`` emits rounds as ``DynamicTopology`` specs; this
+module lowers them to the EXACT collective program the compiler's
+``predicted_collectives`` states — the same fusion rule, applied to the
+same pair lists, so the HLO contract tests can hold the lowering to the
+prediction permute-for-permute and byte-for-byte:
+
+  * a round whose union pair list has all-unique srcs AND dsts fuses
+    into ONE ``lax.ppermute`` carrying the full per-destination shard;
+  * otherwise the round issues one ``lax.ppermute`` per rank-space
+    shift class (each pair's payload depends only on the pair — src
+    sends the shard addressed to dst — so class grouping is free to
+    mix pairs from different torus shifts).
+
+Resilience is DATA, not structure: the wire schedule is static for the
+pod shape, and an expert machine's death only rewrites the traced
+``(route_table, capacity_mask)`` operands (:func:`heal_route_table`),
+so a kill→heal cycle never recompiles — the same shape-stability
+contract the mixing weights already obey.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# Static route/capacity tables built here are communication-authority
+# data the jaxpr checker must treat like comm weights, not model state.
+_WEIGHT_AUTHORITY = True
+
+__all__ = [
+    "DispatchPlan",
+    "dispatch_plan",
+    "all_to_all_dispatch",
+    "naive_all_to_all",
+    "expert_owner",
+    "default_route_table",
+    "heal_route_table",
+    "capacity_mask_of",
+]
+
+Pair = Tuple[int, int]
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchPlan:
+    """The host-side lowering plan of one all-to-all schedule: per
+    round, the ppermute groups (pair tuples) the dispatch issues, in
+    emission order.  ``transpose()`` is the RETURN path — the same
+    groups with every pair reversed, rounds in reverse order — so the
+    combine retraces the dispatch wire exactly."""
+
+    n: int
+    rounds: Tuple[Tuple[Tuple[Pair, ...], ...], ...]
+
+    @property
+    def permutes_per_period(self) -> int:
+        return sum(len(groups) for groups in self.rounds)
+
+    def transpose(self) -> "DispatchPlan":
+        return DispatchPlan(
+            n=self.n,
+            rounds=tuple(
+                tuple(tuple((d, s) for (s, d) in group)
+                      for group in groups)
+                for groups in reversed(self.rounds)))
+
+
+def dispatch_plan(schedule: Sequence) -> DispatchPlan:
+    """Lower a compiled a2a schedule (``DynamicTopology`` rounds, e.g.
+    ``CompiledAllToAll.schedule``) to its ppermute groups under the
+    compiler's fusion rule.  Pure host-side; the result is static data
+    baked into the traced program."""
+    if not schedule:
+        raise ValueError("dispatch_plan needs at least one round")
+    n = schedule[0].size
+    rounds = []
+    for r in schedule:
+        pairs = [p for cls in r.shift_classes for p in cls.perm]
+        srcs = [s for s, _ in pairs]
+        dsts = [d for _, d in pairs]
+        if (len(set(srcs)) == len(srcs)
+                and len(set(dsts)) == len(dsts)):
+            groups = (tuple(sorted(pairs)),)
+        else:
+            groups = tuple(tuple(cls.perm) for cls in r.shift_classes)
+        rounds.append(groups)
+    return DispatchPlan(n=n, rounds=tuple(rounds))
+
+
+def _group_tables(group: Sequence[Pair],
+                  n: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Static send/recv tables of one ppermute group: ``send[r]`` is
+    rank r's destination (-1 when r does not send this group),
+    ``recv[r]`` the rank whose shard lands here (-1 when none)."""
+    send = np.full((n,), -1, np.int32)
+    recv = np.full((n,), -1, np.int32)
+    for s, d in group:
+        send[s] = d
+        recv[d] = s
+    return send, recv
+
+
+def all_to_all_dispatch(x: jax.Array, plan: DispatchPlan,
+                        axis_name: str,
+                        wire_dtype: Optional[str] = None) -> jax.Array:
+    """Run the compiled all-to-all: ``x[d]`` is this rank's shard
+    addressed to rank ``d`` (leading axis ``n``); the result's slot
+    ``s`` holds the shard rank ``s`` addressed here.  The self shard
+    never touches the wire.
+
+    ``wire_dtype="int8"`` compresses each permute's payload with a
+    per-group absmax int8 code (scale rides a second scalar permute) —
+    the lossy wire the determinism tests exercise; the byte-for-byte
+    HLO contract is stated for the default full-precision wire.
+    """
+    if wire_dtype not in (None, "int8"):
+        raise ValueError(f"unknown wire_dtype {wire_dtype!r}")
+    n = plan.n
+    me = lax.axis_index(axis_name)
+    y = jnp.zeros_like(x)
+    y = y.at[me].set(x[me])
+    zero_slot = jnp.zeros_like(x[0])
+    for groups in plan.rounds:
+        for group in groups:
+            send, recv = _group_tables(group, n)
+            dst = jnp.asarray(send)[me]
+            src = jnp.asarray(recv)[me]
+            payload = jnp.where(dst >= 0, x[jnp.clip(dst, 0, n - 1)],
+                                zero_slot)
+            perm = [(int(s), int(d)) for s, d in group]
+            if wire_dtype == "int8":
+                scale = jnp.max(jnp.abs(payload)) / 127.0
+                scale = jnp.where(scale > 0, scale,
+                                  jnp.ones_like(scale))
+                q = jnp.clip(jnp.round(payload / scale), -127,
+                             127).astype(jnp.int8)
+                q = lax.ppermute(q, axis_name, perm)
+                s_in = lax.ppermute(scale, axis_name, perm)
+                out = q.astype(x.dtype) * s_in.astype(x.dtype)
+            else:
+                out = lax.ppermute(payload, axis_name, perm)
+            y = y.at[jnp.clip(src, 0, n - 1)].add(
+                jnp.where(src >= 0, out, jnp.zeros_like(out)))
+    return y
+
+
+def naive_all_to_all(x: jax.Array, axis_name: str) -> jax.Array:
+    """The baseline the bench bills: XLA's own ``lax.all_to_all`` over
+    the shard axis — semantically identical to
+    :func:`all_to_all_dispatch` (tested), topology-blind on the wire."""
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+
+
+# ------------------------------------------------------------------ #
+# expert placement + traced resilience data
+# ------------------------------------------------------------------ #
+def expert_owner(rank: int, n_experts: int) -> int:
+    """Which expert a rank hosts: round-robin, so expert ``e``'s
+    replica set is every rank ``r`` with ``r % n_experts == e``."""
+    return rank % n_experts
+
+
+def default_route_table(n: int, n_experts: int) -> np.ndarray:
+    """``[n, n_experts] int32``: the replica of expert ``e`` that rank
+    ``src`` dispatches to — sources fan out round-robin across the
+    expert's replicas, so no replica is a hot spot by construction."""
+    if not 1 <= n_experts <= n:
+        raise ValueError(
+            f"need 1 <= n_experts <= n, got {n_experts} experts on "
+            f"{n} ranks")
+    route = np.zeros((n, n_experts), np.int32)
+    for e in range(n_experts):
+        replicas = [r for r in range(n) if r % n_experts == e]
+        for src in range(n):
+            route[src, e] = replicas[src % len(replicas)]
+    return route
+
+
+def heal_route_table(route, dead_mask, n_experts: int) -> np.ndarray:
+    """Reroute every dispatch entry pointing at a dead rank to a
+    surviving replica of the same expert (round-robin over survivors —
+    the dead rank's load spreads instead of piling onto one neighbor).
+    Host-side and shape-preserving: the healed table is the SAME
+    ``[n, n_experts]`` traced operand, so swapping it in never
+    recompiles.  An expert with no surviving replica is unroutable —
+    that is a capacity loss no reroute can paper over, so it raises."""
+    route = np.array(route, np.int32, copy=True)
+    n = route.shape[0]
+    dead = np.asarray(dead_mask, bool).reshape(n)
+    for e in range(n_experts):
+        live = [r for r in range(n)
+                if r % n_experts == e and not dead[r]]
+        if not live:
+            raise ValueError(
+                f"expert {e} has no surviving replica — cannot heal")
+        k = 0
+        for src in range(n):
+            if dead[route[src, e]]:
+                route[src, e] = live[k % len(live)]
+                k += 1
+    return route
+
+
+def capacity_mask_of(dead_mask) -> np.ndarray:
+    """``[n] float32``: 1.0 for ranks accepting expert traffic, 0.0
+    for dead ones — the traced multiplier that zeroes contributions
+    from (and to) dead slots without touching the wire schedule."""
+    dead = np.asarray(dead_mask, bool).reshape(-1)
+    return (1.0 - dead.astype(np.float32)).astype(np.float32)

@@ -1,0 +1,140 @@
+"""A small expert-sharded MoE layer: top-1 router, capacity-factor
+overflow as traced data, dispatch over the compiled all-to-all.
+
+Each rank hosts ONE expert replica (``dispatch.expert_owner``); the
+router and any surrounding dense weights are SHARED consensus state
+(mixed by the ordinary neighbor epilogue), while the ``expert`` subtree
+stays rank-local — ``build_train_step(..., moe=MoEConfig(...))`` makes
+that split.  Routing decisions, capacity overflow and expert liveness
+are all TRACED DATA (``route_table``, ``capacity_mask``, the keep
+mask), so membership churn and re-plans never recompile.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bluefog_tpu import config as _config
+from bluefog_tpu.moe.dispatch import DispatchPlan, all_to_all_dispatch
+
+_WEIGHT_AUTHORITY = True
+
+__all__ = [
+    "default_capacity",
+    "init_moe_params",
+    "moe_apply",
+    "make_moe_loss",
+]
+
+
+def default_capacity(tokens_per_rank: int, n_ranks: int,
+                     factor: Optional[float] = None) -> int:
+    """Per-destination shard depth: ``ceil(factor * tokens / n)``,
+    ``factor`` defaulting to the ``BLUEFOG_MOE_CAPACITY_FACTOR`` knob.
+    Every destination rank receives at most this many tokens from each
+    source — the static shard shape the wire carries."""
+    if factor is None:
+        factor = _config.moe_capacity_factor()
+    if factor <= 0:
+        raise ValueError(f"capacity factor must be > 0, got {factor}")
+    return max(1, math.ceil(factor * tokens_per_rank / n_ranks))
+
+
+def init_moe_params(key: jax.Array, d_model: int, d_hidden: int,
+                    n_experts: int):
+    """One rank's parameter tree: a shared router head plus the LOCAL
+    expert MLP.  Build the rank-major stack by vmapping over per-rank
+    keys; the ``expert`` subtree is what ``MoEConfig`` excludes from
+    mixing."""
+    k_r, k_i, k_o = jax.random.split(key, 3)
+    s_in = 1.0 / math.sqrt(d_model)
+    s_hid = 1.0 / math.sqrt(d_hidden)
+    return {
+        "router": {
+            "w": (jax.random.normal(k_r, (d_model, n_experts),
+                                    jnp.float32) * s_in),
+        },
+        "expert": {
+            "wi": (jax.random.normal(k_i, (d_model, d_hidden),
+                                     jnp.float32) * s_in),
+            "wo": (jax.random.normal(k_o, (d_hidden, d_model),
+                                     jnp.float32) * s_hid),
+        },
+    }
+
+
+def moe_apply(params, tokens: jax.Array, route_row: jax.Array,
+              capacity_mask: jax.Array, *, plan: DispatchPlan,
+              axis_name: str, capacity: int,
+              wire_dtype: Optional[str] = None,
+              ) -> Tuple[jax.Array, jax.Array]:
+    """One MoE layer on this rank's ``tokens [B, D]``: route top-1,
+    pack per-destination shards up to ``capacity`` (batch-order
+    overflow drop — the keep mask is returned as traced data), run the
+    compiled dispatch, apply the LOCAL expert MLP to everything that
+    arrived, and retrace the wire back (``plan.transpose()``) for the
+    gate-weighted combine.  Dropped and dead-routed tokens pass
+    through on the residual path.
+
+    ``route_row [n_experts]`` is THIS rank's row of the route table
+    (rank-major like every other per-rank operand — heals swap the
+    stacked ``[n, n_experts]`` table wholesale) and ``capacity_mask``
+    the full ``[n]`` liveness vector.  Returns
+    ``(output [B, D], keep [B] bool)``.
+    """
+    n = plan.n
+
+    logits = tokens @ params["router"]["w"]            # [B, E]
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(logits, axis=-1)               # [B]
+    gate = jnp.take_along_axis(gates, expert[:, None], axis=1)[:, 0]
+    dst = route_row[expert]                            # [B] traced
+
+    # capacity: position of each token within its destination group,
+    # in batch order (deterministic — the overflow drop set is a pure
+    # function of the batch and the route data)
+    dst_onehot = jax.nn.one_hot(dst, n, dtype=tokens.dtype)  # [B, n]
+    before = jnp.cumsum(dst_onehot, axis=0) - dst_onehot
+    pos = jnp.sum(before * dst_onehot, axis=1).astype(jnp.int32)
+    alive = capacity_mask[dst] > 0
+    keep = (pos < capacity) & alive                    # [B]
+
+    comb = (dst_onehot[:, :, None]
+            * jax.nn.one_hot(pos, capacity, dtype=tokens.dtype)[:, None, :]
+            * keep[:, None, None].astype(tokens.dtype))  # [B, n, C]
+    shards = jnp.einsum("bnc,bd->ncd", comb, tokens)   # [n, C, D]
+
+    arrived = all_to_all_dispatch(shards, plan, axis_name,
+                                  wire_dtype=wire_dtype)
+    flat = arrived.reshape(n * capacity, -1)
+    hidden = jax.nn.relu(flat @ params["expert"]["wi"])
+    processed = (hidden @ params["expert"]["wo"]).reshape(arrived.shape)
+    returned = all_to_all_dispatch(processed, plan.transpose(),
+                                   axis_name, wire_dtype=wire_dtype)
+
+    combined = jnp.einsum("bnc,ncd->bd", comb, returned)
+    out = tokens + combined * gate[:, None]
+    return out, keep
+
+
+def make_moe_loss(plan: DispatchPlan, axis_name: str, capacity: int,
+                  wire_dtype: Optional[str] = None):
+    """``loss_fn(params, batch)`` for ``build_train_step``: ``batch``
+    is ``(tokens, route_row, capacity_mask)``, every leaf RANK-MAJOR
+    (tokens ``[n, B, D]``, the route table ``[n, n_experts]``, the
+    liveness mask tiled ``[n, n]``) so the default batch specs shard
+    all three — the route data is ordinary traced batch data, and
+    heals swap it without recompiling."""
+
+    def loss_fn(params, batch):
+        tokens, route_row, capacity_mask = batch
+        out, _ = moe_apply(params, tokens, route_row, capacity_mask,
+                           plan=plan, axis_name=axis_name,
+                           capacity=capacity, wire_dtype=wire_dtype)
+        return jnp.mean(jnp.square(out - tokens))
+
+    return loss_fn

@@ -21,6 +21,7 @@ from bluefog_tpu.optim.functional import (  # noqa: F401
     GuardConfig,
     HealthConfig,
     HealthVector,
+    MoEConfig,
     build_train_step,
     comm_weight_inputs,
     consensus_distance,

@@ -57,7 +57,7 @@ _WEIGHT_AUTHORITY = True
 
 import dataclasses
 from functools import partial
-from typing import Any, Callable, NamedTuple, Optional, Sequence, Union
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -80,6 +80,7 @@ __all__ = [
     "HealthVector",
     "MixCompressConfig",
     "MixState",
+    "MoEConfig",
     "build_train_step",
     "comm_weight_inputs",
     "push_sum_weights",
@@ -235,6 +236,52 @@ class MixState(NamedTuple):
     err: Any
     ref: Any
     mirror: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Expert-sharded MoE policy for :func:`build_train_step`: which
+    parameter leaves are EXPERT-LOCAL and therefore excluded from the
+    neighbor mixing epilogue.  Everything else — router, embeddings,
+    dense trunk — keeps flowing through the ordinary cta/atc combine
+    unchanged, so guard/health/compression compose without new code
+    paths; the expert all-to-all itself lives inside ``loss_fn``
+    (:mod:`bluefog_tpu.moe`), not in the builder.
+
+    * ``n_experts`` — expert count (each rank hosts replica
+      ``rank % n_experts``; see ``moe.dispatch.expert_owner``);
+    * ``capacity`` — per-destination shard depth of the dispatch wire
+      (``moe.layer.default_capacity`` derives one from the
+      ``BLUEFOG_MOE_CAPACITY_FACTOR`` knob);
+    * ``expert_path_tokens`` — a param leaf whose tree path contains
+      any of these substrings is expert-local (matched against
+      ``jax.tree_util.keystr``; the default matches the ``"expert"``
+      subtree of ``moe.layer.init_moe_params``).
+    """
+
+    n_experts: int
+    capacity: int
+    expert_path_tokens: Tuple[str, ...] = ("expert",)
+
+    def __post_init__(self):
+        if self.n_experts < 1 or self.capacity < 1:
+            raise ValueError(
+                f"MoEConfig needs n_experts >= 1 and capacity >= 1, "
+                f"got {self.n_experts} / {self.capacity}")
+        if not self.expert_path_tokens:
+            raise ValueError("expert_path_tokens must be non-empty — "
+                             "an MoE step with no local leaves is just "
+                             "a dense step")
+
+
+def _moe_shared_mask(tree, moe: "MoEConfig"):
+    """Per-leaf booleans in ``jax.tree.leaves`` order: True = shared
+    (mixed by the epilogue), False = expert-local (never on the mixing
+    wire).  Path-based so it works on any pytree shape at trace time."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [not any(tok in jax.tree_util.keystr(path)
+                    for tok in moe.expert_path_tokens)
+            for path, _ in flat]
 
 
 def _tree_sq_sum(tree) -> jax.Array:
@@ -822,6 +869,7 @@ def _build_fused_train_step(
     guard: Optional[GuardConfig],
     health: Optional[HealthConfig],
     mix: Optional[MixCompressConfig] = None,
+    moe: Optional[MoEConfig] = None,
 ) -> Callable:
     """The fused per-bucket epilogue pipeline — the default
     :func:`build_train_step` data plane (see its docstring for the
@@ -1049,7 +1097,7 @@ def _build_fused_train_step(
     ac_branches = [_fused_apply_combine_branch(s, r)
                    for r, s in enumerate(specs)] \
         if (neighbor and comm_mode == "atc" and not guarded
-            and n_buckets is not None) else []
+            and n_buckets is not None and moe is None) else []
     ps_branches = [_fused_push_sum_branch(s) for s in specs] \
         if comm_mode == "push_sum" else []
 
@@ -1080,6 +1128,32 @@ def _build_fused_train_step(
                             lambda op: (op[0], zero(), op[1]),
                             (params, mix_state))
         return run((params, mix_state))
+
+    if moe is not None:
+        # Expert-sharded MoE: only the SHARED leaves ride the mixing
+        # wire.  Wrapping here (a leaf LIST is itself a pytree, so the
+        # branch machinery replans over it unchanged) covers every
+        # fused_combine call site — cta, guarded atc, and the plain atc
+        # fallback — with one partition; expert leaves pass through
+        # untouched and never cost a byte of exchange.
+        _dense_fused_combine = fused_combine
+
+        def fused_combine(params, step, comm_weights, mix_state):
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            mask = _moe_shared_mask(params, moe)
+            if not any(mask):
+                raise ValueError(
+                    f"MoEConfig.expert_path_tokens "
+                    f"{moe.expert_path_tokens!r} match EVERY param "
+                    "leaf — nothing left to mix, the fleet would "
+                    "never reach consensus")
+            shared = [l for l, m in zip(leaves, mask) if m]
+            mixed, cons, mix_state = _dense_fused_combine(
+                shared, step, comm_weights, mix_state)
+            it = iter(mixed)
+            out = [next(it) if m else l for l, m in zip(leaves, mask)]
+            return (jax.tree_util.tree_unflatten(treedef, out), cons,
+                    mix_state)
 
     def fused_apply_then_combine(params, updates, step, comm_weights,
                                  mix_state):
@@ -1336,6 +1410,10 @@ def _build_fused_train_step(
 
         def body(p):
             leaves = [l[0] for l in jax.tree.leaves(p)]
+            if moe is not None:
+                # EF state exists only for leaves that ride the wire
+                mask = _moe_shared_mask(p, moe)
+                leaves = [l for l, m in zip(leaves, mask) if m]
             errs, refs, mirs = [], [], []
             for b in _plan(leaves).buckets:
                 if not jnp.issubdtype(jnp.dtype(b.dtype), jnp.inexact):
@@ -1364,6 +1442,9 @@ def _build_fused_train_step(
         exchange shards, so each tp slice moves its own wire)."""
         rows = []
         shapes = _local_shapes(params)
+        if moe is not None:
+            mask = _moe_shared_mask(params, moe)
+            shapes = [s for s, m in zip(shapes, mask) if m]
         for b in _plan(shapes).buckets:
             if not jnp.issubdtype(jnp.dtype(b.dtype), jnp.inexact):
                 continue
@@ -1400,6 +1481,7 @@ def _build_fused_train_step(
         step_fn.hierarchical_local_size = \
             hierarchical_local_size if neighbor else None
         step_fn.mix_config = mix
+        step_fn.moe_config = moe
         if mix_on:
             step_fn.init_mix_state = init_mix_state
             step_fn.mix_wire_layout = mix_wire_layout
@@ -1495,6 +1577,7 @@ def build_train_step(
     overlap_buckets: int = 4,
     guard: Optional[GuardConfig] = None,
     health: Optional[HealthConfig] = None,
+    moe: Optional[MoEConfig] = None,
 ) -> Callable:
     """Compile one decentralized SGD/optax step over ``mesh``.
 
@@ -1748,6 +1831,13 @@ def build_train_step(
                 "(params, ps_weight) pair must mix as a unit, and a "
                 "per-rank skip would break the column-stochastic "
                 "sum(ps) == n invariant")
+    if moe is not None and comm_mode not in ("cta", "atc"):
+        raise ValueError(
+            "moe= (expert-sharded MoE) partitions the NEIGHBOR combine "
+            "into shared/expert leaves, so it needs comm_mode='cta' or "
+            f"'atc' (got {comm_mode!r}); gradient_allreduce would "
+            "average expert gradients across ranks hosting DIFFERENT "
+            "experts, and push_sum's (x, w) pair cannot be split")
     if overlap == "bucketed":
         if comm_mode not in ("cta", "atc", "push_sum"):
             raise ValueError(
@@ -1772,7 +1862,7 @@ def build_train_step(
             param_specs=param_specs, opt_state_specs=opt_state_specs,
             donate=donate, has_aux=has_aux, compress=compress,
             n_buckets=overlap_buckets if bucketed else None,
-            guard=guard, health=health, mix=mix)
+            guard=guard, health=health, mix=mix, moe=moe)
     # ------- BLUEFOG_FUSE_EPILOGUES=0: the pre-fusion builders -------
     if mix is not None:
         raise ValueError(
@@ -1780,6 +1870,12 @@ def build_train_step(
             "the fused epilogue pipeline — unset "
             "BLUEFOG_FUSE_EPILOGUES=0 (the pre-fusion builders have no "
             "ef_encode/ef_decode stages)")
+    if moe is not None:
+        raise ValueError(
+            "moe= (expert-sharded MoE) needs the fused epilogue "
+            "pipeline — unset BLUEFOG_FUSE_EPILOGUES=0 (the pre-fusion "
+            "builders mix the whole param tree and would drag expert "
+            "leaves onto the wire)")
     if comm_mode == "push_sum" and bucketed:
         raise ValueError(
             "overlap='bucketed' with comm_mode='push_sum' needs the "

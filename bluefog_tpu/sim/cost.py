@@ -18,7 +18,12 @@ of per-operation costs a fleet simulation charges —
 * ``wire_unit_s`` — virtual seconds per unit of ``PodSpec`` round cost
   (per-link pricing stays in ``PodSpec``: the sim multiplies its
   contention-priced cost units by this scale, the same convention the
-  adaptive-topology bench's virtual wire established).
+  adaptive-topology bench's virtual wire established);
+* ``a2a_unit_s`` — virtual seconds per unit of all-to-all dispatch
+  cost (``compile_all_to_all``'s per-round charges).  Separate from
+  ``wire_unit_s`` because expert dispatch moves activations, not
+  parameter deltas: its payload scales with tokens per step, so its
+  calibration anchor differs from the mixing wire's.
 
 Two ways to get one:
 
@@ -85,6 +90,7 @@ class CostModel:
     gossip_round_s: float = 1e-4
     train_step_s: float = 1e-3
     wire_unit_s: float = 1e-3
+    a2a_unit_s: float = 1e-3
 
     def __post_init__(self):
         for f in dataclasses.fields(self):
@@ -102,6 +108,11 @@ class CostModel:
         """Convert ``PodSpec`` contention-priced cost units (a round's
         bottleneck-link charge) into virtual seconds."""
         return float(cost_units) * self.wire_unit_s
+
+    def a2a_s(self, cost_units: float) -> float:
+        """Convert an all-to-all dispatch round's ``PodSpec`` cost
+        units (``compile_all_to_all`` pricing) into virtual seconds."""
+        return float(cost_units) * self.a2a_unit_s
 
     # -- calibration ---------------------------------------------------- #
     @classmethod

@@ -82,8 +82,8 @@ import numpy as np
 # without jax: healing and observability are imported inside the
 # functions that need them (they pull the jitted stack transitively)
 from bluefog_tpu import config as _config
-from bluefog_tpu.topology.compiler import PodSpec, Sketch, compile_topology, \
-    expand_machine_pairs, menu_schedules
+from bluefog_tpu.topology.compiler import CompiledAllToAll, PodSpec, Sketch, \
+    compile_all_to_all, compile_topology, expand_machine_pairs, menu_schedules
 from bluefog_tpu.topology.spec import DynamicTopology
 from bluefog_tpu.topology.torus import rounds_from_contraction
 
@@ -307,11 +307,18 @@ class TopologyControlPlane:
         self._mix_probation_end: Optional[int] = None
         self._mix_preswap_health: Optional[float] = None
         self._mix_clean_windows = 0
+        # a2a (expert-dispatch) planning state: the last telemetry-
+        # calibrated pod a trigger produced, and the a2a schedule
+        # compiled against it.  Invalidated whenever a fresh
+        # calibration lands, so plan_all_to_all() re-prices lazily.
+        self._last_calibrated_pod: Optional[PodSpec] = None
+        self._a2a_plan: Optional[CompiledAllToAll] = None
         self.swaps = 0
         self.rollbacks = 0
         self.triggers = 0
         self.mix_swaps = 0
         self.mix_rollbacks = 0
+        self.a2a_replans = 0
         self.last_scores: Dict[str, float] = {}
 
     # ------------------------------------------------------------ #
@@ -348,6 +355,28 @@ class TopologyControlPlane:
                 "live compression ratio")
         with self._lock:
             return self.mix_ratios[self._mix_index]
+
+    def plan_all_to_all(self, sketch: Optional[Sketch] = None,
+                        ) -> CompiledAllToAll:
+        """The expert-dispatch all-to-all schedule priced against the
+        CURRENT network view: the last telemetry-calibrated pod when a
+        trigger has re-priced one, the nominal pod before any window
+        fired.  Lazy and cached — each fresh calibration invalidates
+        the cache, so the first call after a trigger re-plans (counted
+        in ``a2a_replans``) and later calls are free.  The emitted
+        rounds feed ``moe.dispatch_plan`` exactly like a cold compile;
+        whether a re-planned wire is worth a recompile is the caller's
+        trade, the plane only prices it."""
+        with self._lock:
+            cached = self._a2a_plan
+            pod = self._last_calibrated_pod or self.pod
+        if cached is not None:
+            return cached
+        plan = compile_all_to_all(pod, sketch or self.sketch)
+        with self._lock:
+            self._a2a_plan = plan
+            self.a2a_replans += 1
+        return plan
 
     # ------------------------------------------------------------ #
     # projection: candidate -> carrier-shaped specs
@@ -766,6 +795,10 @@ class TopologyControlPlane:
             self._degraded_streak = 0
             self._state = SYNTHESIZING
             pod_w = self._calibrated_pod(secs, z)
+            # the a2a planner prices against the same window's costs;
+            # stale any cached dispatch schedule so it re-plans lazily
+            self._last_calibrated_pod = pod_w
+            self._a2a_plan = None
             dead_now = self._dead.copy()
             self.triggers += 1
             self._count("trigger")

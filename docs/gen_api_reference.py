@@ -126,6 +126,12 @@ MODULES = [
      "simulated replicas + lockstep fleet around the real router"),
     ("bluefog_tpu.sim.training",
      "simulated training fleet driving the real control plane"),
+    ("bluefog_tpu.moe",
+     "MoE expert parallelism: compiled a2a dispatch + expert sharding"),
+    ("bluefog_tpu.moe.dispatch",
+     "all-to-all dispatch plans, route tables, capacity healing"),
+    ("bluefog_tpu.moe.layer",
+     "top-k routed MoE layer + the expert-sharded loss"),
     ("bluefog_tpu.analysis",
      "static contract checker (bfcheck): findings + baseline"),
     ("bluefog_tpu.analysis.lint",

@@ -321,6 +321,32 @@ def test_rule_weight_bypass(tmp_path):
         """, **_PKG) == []
 
 
+def test_rule_weight_bypass_covers_route_tables(tmp_path):
+    """MoE route tables and capacity masks are communication-authority
+    data: raw construction outside an authority module flags, the
+    sanctioned moe.dispatch helpers and authority modules pass."""
+    fs = _lint_src(tmp_path, """
+        import numpy as np
+        route_table = np.zeros((8, 4))
+        capacity_mask = np.ones((8,))
+        """, **_PKG)
+    assert [f.rule for f in fs] == ["weight-matrix-bypass"] * 2
+    # through the sanctioned heal/build helpers: fine
+    assert _lint_src(tmp_path, """
+        from bluefog_tpu.moe import (default_route_table,
+                                     heal_route_table, capacity_mask_of)
+        route_table = default_route_table(8, 4)
+        route_table = heal_route_table(route_table, dead, 4)
+        capacity_mask = capacity_mask_of(dead)
+        """, **_PKG) == []
+    # authority modules construct tables from scratch by design
+    assert _lint_src(tmp_path, """
+        _WEIGHT_AUTHORITY = True
+        import numpy as np
+        route_table = np.zeros((8, 4))
+        """, **_PKG) == []
+
+
 def test_rule_weight_swap_boundary(tmp_path):
     fs = _lint_src(tmp_path, """
         def hotfix(comm_weights, delta):
@@ -358,16 +384,18 @@ def test_rule_weight_swap_boundary(tmp_path):
 
 
 def test_weight_authority_modules_are_marked():
-    """The five modules that legitimately build weight tables carry
+    """The modules that legitimately build weight/route tables carry
     the authority marker (so the rule has a principled escape hatch,
     not an ad-hoc path list)."""
     import bluefog_tpu.elastic.membership as m1
-    import bluefog_tpu.optim.functional as m2
-    import bluefog_tpu.parallel.collectives as m3
-    import bluefog_tpu.resilience.healing as m4
-    import bluefog_tpu.topology.spec as m5
+    import bluefog_tpu.moe.dispatch as m2
+    import bluefog_tpu.moe.layer as m3
+    import bluefog_tpu.optim.functional as m4
+    import bluefog_tpu.parallel.collectives as m5
+    import bluefog_tpu.resilience.healing as m6
+    import bluefog_tpu.topology.spec as m7
 
-    for mod in (m1, m2, m3, m4, m5):
+    for mod in (m1, m2, m3, m4, m5, m6, m7):
         assert getattr(mod, "_WEIGHT_AUTHORITY", False) is True, mod
 
 

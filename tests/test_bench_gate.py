@@ -494,3 +494,68 @@ def test_gate_catches_compressed_wire_regression(capsys):
     # ... and the committed record gates clean against itself
     ok2, _ = bench_compare(base, base)
     assert ok2 is True
+
+
+# --------------------------------------------------------------------- #
+# moe-dispatch baseline (ISSUE 19): the compiled all-to-all joins the
+# gate flow — moe.cost_to_dispatch and moe.dcn_bytes_per_step are gated
+# lower-is-better headlines and moe.compiled_advantage higher-is-better,
+# so a compiler change that silently hands the dispatch back to the
+# naive fused round (advantage -> 1.0, bytes re-inflated) fails the
+# compare
+# --------------------------------------------------------------------- #
+@pytest.mark.moe
+def test_moe_dispatch_defaults_and_baseline():
+    """moe_dispatch.py gates against the committed r19 artifact by
+    default; ``--compare ''`` opts out; the committed record passed
+    every machine-checked claim: compiled beats naive on
+    cost-to-dispatch at the 4x DCN pod without violating the one-shot
+    congestion bound, the measured dispatch is bit-identical to
+    lax.all_to_all, the int8 wire quarters the DCN bytes, and the
+    expert kill->heal cycle completed with zero recompiles."""
+    md = _load_bench_module("moe_dispatch")
+    args = md.parse_args([])
+    assert args.compare == md.DEFAULT_BASELINE
+    assert os.path.exists(args.compare)
+    assert md.parse_args(["--compare", ""]).compare is None
+    assert md.parse_args(["--compare", "x.json"]).compare == "x.json"
+    base = _load(os.path.join("benchmarks", "moe_dispatch_r19.json"))
+    assert all(base["checks"].values())
+    moe = base["moe"]
+    assert moe["cost_to_dispatch"] < moe["naive_cost_to_dispatch"]
+    assert moe["compiled_advantage"] > 1.0
+    assert moe["cost_to_dispatch"] >= moe["one_shot_lower_bound"] - 1e-9
+    assert moe["dcn_bytes_per_step_int8"] == moe["dcn_bytes_per_step"] / 4
+    assert base["heal"]["recompiles"] == 0
+    assert base["measured"]["bit_identical_to_naive"] is True
+    from bluefog_tpu.benchutil import bench_headline
+
+    head = bench_headline(base)
+    assert "moe.cost_to_dispatch" in head
+    assert "moe.compiled_advantage" in head
+    assert "moe.dcn_bytes_per_step" in head
+    assert "measured.step_time_ratio" in head
+
+
+@pytest.mark.moe
+def test_gate_catches_dispatch_bytes_regression(capsys):
+    """A synthetic dispatch-bytes regression — the compiler handing the
+    wire back to the naive round (cost up, advantage gone, DCN bytes
+    re-inflated) — fails the gate on all three headline directions."""
+    from bluefog_tpu.benchutil import bench_compare
+
+    base = _load(os.path.join("benchmarks", "moe_dispatch_r19.json"))
+    regressed = copy.deepcopy(base)
+    regressed["moe"]["cost_to_dispatch"] = (
+        base["moe"]["naive_cost_to_dispatch"])
+    regressed["moe"]["compiled_advantage"] = 1.0
+    regressed["moe"]["dcn_bytes_per_step"] *= 2.0
+    ok, rows = bench_compare(regressed, base, tolerance=0.05)
+    assert ok is False
+    bad = {r["name"] for r in rows if r["regressed"]}
+    assert "moe.cost_to_dispatch" in bad
+    assert "moe.compiled_advantage" in bad
+    assert "moe.dcn_bytes_per_step" in bad
+    # ... and the committed record gates clean against itself
+    ok2, _ = bench_compare(base, base)
+    assert ok2 is True

@@ -648,3 +648,62 @@ def test_n1024_control_plane_smoke():
 
     s2 = build().run(20)
     assert s2["event_digest"] == s["event_digest"]
+
+
+@pytest.mark.moe
+def test_n1024_a2a_dispatch_wire_smoke():
+    """1024-rank MoE dispatch: a2a rounds built by the compiler's
+    shift-class decomposition billed through ``LinkWire`` inside a
+    ``SimTrainingFleet`` — DCN rounds cost more than ICI rounds under
+    the heterogeneous pod, ``CostModel.a2a_s`` prices the charge, and
+    the whole run is digest-deterministic inside the tier-1 budget."""
+    from bluefog_tpu.topology import (DynamicTopology, PodSpec,
+                                      TopologyControlPlane)
+    from bluefog_tpu.topology.compiler import _a2a_round_topology
+
+    n, machines, local = 1024, 128, 8
+
+    def carrier():
+        shifts = (1, 8, 64, 512)
+        w = 1.0 / (len(shifts) + 1)
+        ew = {(i, (i + s) % n): w for s in shifts for i in range(n)}
+        return [DynamicTopology.from_edges(n, ew, [w] * n)] * 2
+
+    def build():
+        pod = PodSpec(machines, local, ici_cost=1.0, dcn_cost=4.0)
+        # Two dispatch rounds off the a2a compiler's shift classes:
+        # a chip-axis (ICI) shift and a machine-axis (DCN) shift — the
+        # two link classes the schedule synthesis trades off.
+        rounds = [
+            _a2a_round_topology([(0, 1)], pod),
+            _a2a_round_topology([(1, 0)], pod),
+        ]
+        reg = MetricsRegistry()
+        control = TopologyControlPlane(pod, carrier(), registry=reg,
+                                       synchronous=True)
+        wire = LinkWire(pod, reg,
+                        schedule_fn=lambda s: rounds[s % 2],
+                        dead_fn=lambda: np.zeros(n, bool),
+                        wire_unit=1e-3, period=2)
+        return SimTrainingFleet(
+            control=control, wire=wire,
+            cost=CostModel(train_step_s=1e-3, a2a_unit_s=2e-3),
+            sim=Simulation(log=EventLog(keep_lines=False)))
+
+    fleet = build()
+    s = fleet.run(12)
+    assert s["ranks"] == 1024
+    assert s["virtual_seconds"] > 0
+
+    charges = dict(fleet.wire.charges)
+    ici, dcn = charges[0], charges[1]
+    assert ici > 0 and dcn > 0
+    # the machine-axis shift crosses 4x DCN links; the chip-axis round
+    # stays on unit-cost ICI — heterogeneity must show in the bill
+    assert dcn > ici
+    # a2a_unit_s is the dispatch anchor, independent of wire_unit_s
+    assert fleet.cost.a2a_s(dcn) == pytest.approx(dcn * 2e-3)
+    assert fleet.cost.a2a_s(dcn) != fleet.cost.wire_s(dcn)
+
+    s2 = build().run(12)
+    assert s2["event_digest"] == s["event_digest"]

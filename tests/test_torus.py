@@ -236,3 +236,38 @@ def test_default_pod_schedule_drives_train_step():
                                        jnp.int32(i))
     # pure averaging (lr 0): one period -> exact consensus
     assert float(F.consensus_distance(params)) < 1e-6
+
+
+def test_link_loads_duplicate_src_multi_shift_additive():
+    """The multi-shift form of ``link_loads`` (an a2a round: one src
+    sends to SEVERAL dsts in the same round) must price exactly like
+    the sum of its per-shift parts: loads are additive over pair lists,
+    duplicate pairs accumulate, and per-pair payloads scale linearly —
+    the property the all-to-all compiler's round costs rest on."""
+    spec = TorusSpec((4, 4))
+    n = spec.size
+
+    def shift_pairs(s):
+        return [(i, (i + s) % n) for i in range(n)]
+
+    a, b = shift_pairs(3), shift_pairs(7)
+    both = link_loads(a + b, spec)           # duplicate srcs across shifts
+    la, lb = link_loads(a, spec), link_loads(b, spec)
+    merged = dict(la)
+    for k, v in lb.items():
+        merged[k] = merged.get(k, 0.0) + v
+    assert set(both) == set(merged)
+    for k in merged:
+        assert both[k] == pytest.approx(merged[k])
+
+    # duplicate PAIRS accumulate (the docstring's contract)
+    twice = link_loads(a + a, spec)
+    for k, v in la.items():
+        assert twice[k] == pytest.approx(2.0 * v)
+
+    # payloads scale each pair's contribution linearly
+    scaled = link_loads(a, spec, payloads={p: 3.0 for p in a})
+    for k, v in la.items():
+        assert scaled[k] == pytest.approx(3.0 * v)
+    # zero payload pairs route nothing
+    assert link_loads(a, spec, payloads={p: 0.0 for p in a}) == {}
