@@ -49,6 +49,16 @@ and by scattered tests; the lint makes them mechanical:
     (``serving.resilience.backoff_sleep``): deterministic delays keyed
     on (seed, request, attempt) are what make chaos runs replayable and
     keep retry storms from synchronizing across replicas.
+``decision-outside-recorder``
+    A control plane's state-transition method (the topology plane's
+    swap/synthesize path, membership admit/promote/kick, router
+    excision, drain/failover, heal re-plans) that never emits through
+    the decision flight recorder (``observe.blackbox.record_decision``
+    or a ``_decide`` helper that wraps it).  Every plane transition
+    must leave a causal audit record — a silent transition is exactly
+    the unexplainable swap the blackbox exists to prevent.  The
+    sanctioned method list lives in ``_DECISION_PLANE_METHODS`` (the
+    ``_WEIGHT_AUTHORITY``-style registry for this rule).
 ``wallclock-in-sim``
     ``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()``
     (and their ``_ns`` variants, however imported) under
@@ -131,6 +141,31 @@ WEIGHT_HELPERS = {
 # the step-boundary swap helper (topology.control).  Functions with
 # these names may touch weight tables element-wise.
 _SWAP_BOUNDARY_HELPERS = {"swap_comm_weights"}
+
+# control-plane state-transition methods that must emit a decision
+# record (the decision-outside-recorder rule): repo-relative module ->
+# method/function names.  This is the sanctioned-callsite registry —
+# adding a plane transition means adding it here AND wiring it through
+# observe.blackbox.
+_DECISION_PLANE_METHODS = {
+    "bluefog_tpu/topology/control.py": frozenset(
+        {"on_step", "_synthesize", "force_candidate",
+         "_mix_ladder_step", "plan_all_to_all"}),
+    "bluefog_tpu/elastic/membership.py": frozenset(
+        {"admit", "promote", "kick", "mark_dead"}),
+    "bluefog_tpu/serving/fleet.py": frozenset({"poll", "submit"}),
+    "bluefog_tpu/serving/engine.py": frozenset({"drain"}),
+    "bluefog_tpu/serving/resilience.py": frozenset(
+        {"failover_stranded"}),
+    "bluefog_tpu/resilience/healing.py": frozenset(
+        {"healed_comm_weights"}),
+    "bluefog_tpu/moe/dispatch.py": frozenset({"heal_route_table"}),
+    "bluefog_tpu/sim/serving.py": frozenset({"_kill"}),
+}
+
+# a call with one of these terminal names counts as "emitted through
+# the recorder": the blackbox API itself, or a plane's _decide wrapper
+_DECISION_EMITTERS = {"record_decision", "_decide"}
 
 # raw ndarray constructors that build a table from scratch
 _RAW_CONSTRUCTORS = {
@@ -592,6 +627,39 @@ class _WallClockVisitor(_ScopeTracker):
 
 
 # --------------------------------------------------------------------- #
+# rule: decision-outside-recorder (control-plane modules)
+# --------------------------------------------------------------------- #
+
+def _decision_findings(tree: ast.Module, rel: str,
+                       methods: Set[str]) -> List[Finding]:
+    """Flag every function/method in ``methods`` whose body (nested
+    defs included) never calls a ``_DECISION_EMITTERS`` name.  The
+    check is name-anchored, not class-anchored, so fixtures and
+    refactors keep working; a method that delegates to a ``_decide``
+    wrapper passes (the wrapper is the plane's sanctioned seam)."""
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        if node.name not in methods:
+            continue
+        emits = any(
+            isinstance(n, ast.Call)
+            and _last_attr(n.func) in _DECISION_EMITTERS
+            for n in ast.walk(node))
+        if not emits:
+            findings.append(Finding(
+                "decision-outside-recorder", rel, node.lineno,
+                node.name,
+                f"control-plane transition '{node.name}' never emits "
+                "through the decision flight recorder; record it via "
+                "observe.blackbox.record_decision (or the plane's "
+                "_decide wrapper) so the transition stays auditable"))
+    return findings
+
+
+# --------------------------------------------------------------------- #
 # rule: unregistered-pytest-marker (tests/)
 # --------------------------------------------------------------------- #
 
@@ -645,13 +713,17 @@ def lint_file(path: str, rel: str, *, markers: Set[str],
               in_package: bool, in_benchmarks: bool,
               in_tests: bool,
               in_serving: Optional[bool] = None,
-              in_sim: Optional[bool] = None) -> List[Finding]:
+              in_sim: Optional[bool] = None,
+              plane_methods: Optional[Set[str]] = None) -> List[Finding]:
     """All findings for one file.  ``rel`` is the repo-relative posix
     path recorded on the findings; the ``in_*`` flags select which rule
     families apply (set by :func:`run_lint` from the file's location).
     ``in_serving`` / ``in_sim`` default from ``rel`` (files under
     ``bluefog_tpu/serving/`` / ``bluefog_tpu/sim/``); pass them
-    explicitly to force the rule on a fixture."""
+    explicitly to force the rule on a fixture.  ``plane_methods``
+    defaults from ``_DECISION_PLANE_METHODS[rel]`` (empty elsewhere);
+    pass a method-name set explicitly to force the
+    decision-outside-recorder rule on a fixture."""
     try:
         tree = ast.parse(open(path).read(), filename=path)
     except SyntaxError as e:
@@ -661,7 +733,11 @@ def lint_file(path: str, rel: str, *, markers: Set[str],
         in_serving = rel.startswith("bluefog_tpu/serving/")
     if in_sim is None:
         in_sim = rel.startswith("bluefog_tpu/sim/")
+    if plane_methods is None:
+        plane_methods = _DECISION_PLANE_METHODS.get(rel, frozenset())
     findings: List[Finding] = []
+    if plane_methods:
+        findings += _decision_findings(tree, rel, plane_methods)
     if in_package:
         if os.path.basename(path) != "config.py":
             v = _EnvReadVisitor(rel)

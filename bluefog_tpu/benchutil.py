@@ -879,13 +879,13 @@ _HEADLINE_HIGHER = ("value", "mfu", "tokens_per_sec", "useful_tokens",
                     "compiled_advantage", "hit_rate",
                     "accepted_per_step", "fleet_speedup",
                     "throughput_recovery", "tp_overlap_fraction",
-                    "cost_to_consensus_advantage")
+                    "cost_to_consensus_advantage", "decisions_replayed")
 _HEADLINE_LOWER = ("ttft_p50", "ttft_p99", "latency_p50", "latency_p99",
                    "makespan_s", "p99", "p50", "cost_to_consensus",
                    "post_rejoin_floor", "dcn_bytes_per_step",
                    "lost_requests", "step_time_ratio",
                    "consensus_floor", "mean_drift", "detect_to_swap_s",
-                   "cost_to_dispatch")
+                   "cost_to_dispatch", "mismatches")
 
 
 def bench_headline(record: dict) -> dict:
@@ -913,7 +913,7 @@ def bench_headline(record: dict) -> dict:
                     "hierarchical", "fault_free", "chaos_serving",
                     "drain", "adaptation", "congested", "shrink",
                     "rollback", "compressed", "sim_training",
-                    "sim_serving", "moe", "measured"):
+                    "sim_serving", "moe", "measured", "replay"):
         if isinstance(record.get(section), dict):
             grab(record[section], section + ".")
     return out
@@ -950,24 +950,51 @@ def bench_compare(current: dict, previous: dict, tolerance: float = 0.05,
     return ok, rows
 
 
+def _record_round(path: str, record: dict) -> str:
+    """The baseline record's round, for gate attribution: the ``_r<N>``
+    filename convention first (``fleet_sim_r20.json`` -> ``r20``), then
+    an explicit ``round`` field, else ``r?``."""
+    m = re.search(r"_r(\d+)", path.rsplit("/", 1)[-1])
+    if m:
+        return "r" + m.group(1)
+    rec = record.get("parsed") if isinstance(record.get("parsed"),
+                                             dict) else record
+    rnd = rec.get("round") if isinstance(rec, dict) else None
+    return f"r{rnd}" if rnd is not None else "r?"
+
+
+def _record_sections(record: dict) -> str:
+    """Comma-joined section names (dict-valued keys) of a bench record —
+    what a no-shared-metrics mismatch message lists for each side."""
+    if isinstance(record.get("parsed"), dict):
+        record = record["parsed"]
+    secs = sorted(k for k, v in record.items() if isinstance(v, dict))
+    return ",".join(secs) if secs else "-"
+
+
 def bench_regression_gate(current: dict, prev_path: str,
                           tolerance: float = 0.05,
                           tolerances: dict = None) -> bool:
     """Gate ``current`` against the record stored at ``prev_path``:
-    prints the one-line delta table and returns False on regression
-    (callers ``sys.exit(1)``)."""
+    prints the one-line delta table (naming the baseline file and its
+    record round, so a failing gate says exactly which artifact it
+    compared against) and returns False on regression (callers
+    ``sys.exit(1)``)."""
     import json as _json
 
     with open(prev_path) as fh:
         previous = _json.load(fh)
+    rnd = _record_round(prev_path, previous)
     ok, rows = bench_compare(current, previous, tolerance, tolerances)
     if not rows:
-        print(f"[bench-gate] no shared headline metrics with {prev_path}")
+        print(f"[bench-gate] no shared headline metrics with {prev_path} "
+              f"({rnd}): current sections [{_record_sections(current)}] "
+              f"vs baseline sections [{_record_sections(previous)}]")
         return True
     cells = []
     for r in rows:
         mark = "REGRESSED" if r["regressed"] else "ok"
         cells.append(f"{r['name']} {r['prev']:.4g}->{r['cur']:.4g} "
                      f"({r['delta_frac']:+.1%} {mark})")
-    print(f"[bench-gate] vs {prev_path}: " + " | ".join(cells))
+    print(f"[bench-gate] vs {prev_path} ({rnd}): " + " | ".join(cells))
     return ok

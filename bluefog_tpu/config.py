@@ -16,6 +16,9 @@ __all__ = [
     "log_format",
     "observe",
     "observe_raw",
+    "blackbox_enabled",
+    "blackbox_capacity",
+    "blackbox_dump_dir",
     "timeline_path",
     "timeline_flush_every",
     "timeline_queue_capacity",
@@ -92,6 +95,37 @@ def observe_raw() -> bool:
     publishers call; it delegates here so the env access itself lives in
     this module (the ``env-read-outside-config`` lint contract)."""
     return _env("BLUEFOG_OBSERVE", "1") not in ("0", "false", "False")
+
+
+def blackbox_enabled() -> bool:
+    """BLUEFOG_BLACKBOX (default on): whether the control planes record
+    into the process-global decision flight recorder
+    (:mod:`bluefog_tpu.observe.blackbox`).  ``0`` opts out; compiled
+    programs and step outputs are bit-identical either way — the
+    recorder is host-side only, like BLUEFOG_OBSERVE."""
+    return _env("BLUEFOG_BLACKBOX", "1") not in ("0", "false", "False")
+
+
+def blackbox_capacity() -> int:
+    """BLUEFOG_BLACKBOX_CAPACITY (default 4096): bound of the decision
+    flight recorder's event ring.  At capacity the oldest event is
+    evicted and counted (``bf_blackbox_dropped_events``) — O(1) memory
+    however long the run; the streaming chain digest is unaffected by
+    eviction."""
+    try:
+        return max(1, int(_env("BLUEFOG_BLACKBOX_CAPACITY", "4096")))
+    except ValueError:
+        return 4096
+
+
+def blackbox_dump_dir() -> str:
+    """BLUEFOG_BLACKBOX_DUMP: directory the recorder dumps its ring
+    into (one JSONL file per anomaly kind) when an anomaly — rollback,
+    ``rank_join_failed``, lost request, bench-gate failure — is
+    recorded.  Empty (the default) disables the file dump; the
+    Chrome-trace instant and the drop/decision counters publish either
+    way."""
+    return _env("BLUEFOG_BLACKBOX_DUMP", "")
 
 
 def timeline_path() -> str:

@@ -212,7 +212,8 @@ class MembershipController:
     def __init__(self, schedule, *,
                  bootstrap_rounds: Optional[int] = None,
                  quarantine_threshold: Optional[float] = None,
-                 detector=None):
+                 detector=None,
+                 blackbox=None):
         if isinstance(schedule, (Topology, DynamicTopology)):
             schedule = [schedule]
         if not schedule:
@@ -235,6 +236,14 @@ class MembershipController:
         self._code = np.zeros(self.size, np.int8)
         self._progress = np.zeros(self.size, np.int64)
         self._steady: "OrderedDict[bytes, tuple]" = OrderedDict()
+        # decision flight recorder: ``current_step`` is stamped by the
+        # driving loop (run_resilient / SimTrainingFleet) so lifecycle
+        # decisions carry the training step they happened at; each
+        # joiner's admit event parents its eventual promote/kick, so
+        # the audit chain reads admit→promote or admit→kick.
+        self.blackbox = blackbox
+        self.current_step = -1
+        self._join_events: Dict[int, object] = {}
 
     # ------------------------------------------------------------- #
     # views
@@ -316,6 +325,9 @@ class MembershipController:
         if rs and self.detector is not None:
             self.detector.declare_dead(
                 [r for r in rs if not self.detector.dead_mask()[r]])
+        for r in rs:
+            self._decide("mark_dead", rank=r,
+                         parent=self._join_events.pop(r, None))
         self._publish("dead", len(rs))
 
     def admit(self, ranks: Union[int, Sequence[int]]) -> None:
@@ -331,6 +343,9 @@ class MembershipController:
                     "ranks can be admitted")
             self._code[r] = _CODE[JOINING]
             self._progress[r] = 0
+            ev = self._decide("admit", rank=r)
+            if ev is not None:
+                self._join_events[r] = ev
         self._publish("joining", len(_as_ranks(ranks)))
 
     def promote(self, ranks: Union[int, Sequence[int]]) -> None:
@@ -351,6 +366,9 @@ class MembershipController:
             self._progress[r] = 0
         if rs and self.detector is not None:
             self.detector.readmit(rs)
+        for r in rs:
+            self._decide("promote", rank=r,
+                         parent=self._join_events.pop(r, None))
         self._publish("live", len(rs))
 
     def kick(self, ranks: Union[int, Sequence[int]]) -> None:
@@ -362,8 +380,12 @@ class MembershipController:
                 raise ValueError(
                     f"rank {r} is {self.state(r)}, not joining — only "
                     "joining ranks can be kicked")
+            progress = int(self._progress[r])
             self._code[r] = _CODE[DEAD]
             self._progress[r] = 0
+            self._decide("kick", rank=r,
+                         parent=self._join_events.pop(r, None),
+                         progress=progress)
         self._publish("dead", len(_as_ranks(ranks)))
 
     def tick(self) -> None:
@@ -468,6 +490,20 @@ class MembershipController:
     # ------------------------------------------------------------- #
     # observability
     # ------------------------------------------------------------- #
+    def _decide(self, kind: str, *, rank: int, parent=None, **detail):
+        """The one blackbox emission seam of the membership plane (the
+        ``decision-outside-recorder`` lint rule holds every lifecycle
+        transition to it)."""
+        from bluefog_tpu.observe import blackbox as _blackbox
+
+        counts = self.counts()
+        return _blackbox.record_decision(
+            "membership", kind, step=self.current_step, parent=parent,
+            telemetry={"rank": int(rank), "live": counts[LIVE],
+                       "dead": counts[DEAD], "joining": counts[JOINING]},
+            winner=str(int(rank)), blackbox=self.blackbox,
+            detail=detail or None)
+
     def _publish(self, to_state: str, moved: int) -> None:
         from bluefog_tpu import observe
 

@@ -206,6 +206,13 @@ def heal_route_table(route, dead_mask, n_experts: int) -> np.ndarray:
             if dead[route[src, e]]:
                 route[src, e] = live[k % len(live)]
                 k += 1
+    if dead.any():
+        from bluefog_tpu.observe import blackbox as _blackbox
+
+        _blackbox.record_decision(
+            "moe", "replan", step=-1,
+            telemetry={"dead": [int(i) for i in np.flatnonzero(dead)],
+                       "n_experts": int(n_experts), "size": int(n)})
     return route
 
 

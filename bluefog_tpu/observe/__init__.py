@@ -52,4 +52,21 @@ __all__ = [
     "FleetAggregate", "FleetAggregator", "StragglerDetector",
     "collect_local", "edge_list", "push_sum_matrix",
     "record_edge_traffic",
+    "BlackBox", "DecisionEvent", "explain", "get_blackbox",
+    "record_decision",
 ]
+
+# The decision flight recorder resolves lazily: its module reaches
+# into bluefog_tpu.sim for the canonical byte-stable formatting, and
+# the sim package in turn imports the control planes that record into
+# it — binding it here eagerly would cycle the package imports.
+_BLACKBOX_EXPORTS = ("BlackBox", "DecisionEvent", "explain",
+                     "get_blackbox", "record_decision")
+
+
+def __getattr__(name):
+    if name in _BLACKBOX_EXPORTS:
+        from bluefog_tpu.observe import blackbox as _blackbox
+        return getattr(_blackbox, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

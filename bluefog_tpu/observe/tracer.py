@@ -33,9 +33,14 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional
 
-from bluefog_tpu.observe.registry import enabled
+from bluefog_tpu.observe.registry import enabled, get_registry
 
 __all__ = ["Tracer", "get_tracer", "publish_tracer", "effective_tracer"]
+
+#: consecutive ``record()`` failures after which a sink is detached —
+#: a persistently broken sink (full disk, closed pipe) must not keep
+#: throwing inside every producer's span emission
+SINK_ERROR_LIMIT = 3
 
 
 class Tracer:
@@ -61,6 +66,8 @@ class Tracer:
         self._events: deque = deque(maxlen=max_events)
         self._n_emitted = 0
         self._sinks: List[object] = []
+        # id(sink) -> consecutive record() failures; any success resets
+        self._sink_errors: Dict[int, int] = {}
         self._open_spans: Dict[str, List[str]] = {}
         # per-thread (track, name) stack: which span THIS thread is
         # inside right now — the correlation source structured logs
@@ -74,11 +81,13 @@ class Tracer:
         with self._lock:
             if sink not in self._sinks:
                 self._sinks.append(sink)
+            self._sink_errors.pop(id(sink), None)
 
     def remove_sink(self, sink) -> None:
         with self._lock:
             if sink in self._sinks:
                 self._sinks.remove(sink)
+            self._sink_errors.pop(id(sink), None)
 
     # -- core emit ----------------------------------------------------- #
     def _now_us(self) -> float:
@@ -94,8 +103,28 @@ class Tracer:
         the same lock around its writer)."""
         self._events.append((phase, name, track, self._now_us()))
         self._n_emitted += 1
-        for sink in self._sinks:
-            sink.record(name, track, phase)
+        # sink fan-out is fault-isolated: one raising sink must not
+        # break span emission for the producers (or starve the other
+        # sinks), and the per-thread span stack stays consistent
+        # because the event was already buffered above.  A sink that
+        # fails SINK_ERROR_LIMIT times in a row is detached.
+        for sink in list(self._sinks):
+            try:
+                sink.record(name, track, phase)
+            except Exception:
+                errs = self._sink_errors.get(id(sink), 0) + 1
+                self._sink_errors[id(sink)] = errs
+                if enabled():
+                    get_registry().counter(
+                        "bf_tracer_sink_errors_total",
+                        "tracer sink record() failures",
+                        sink=type(sink).__name__).inc()
+                if errs >= SINK_ERROR_LIMIT:
+                    if sink in self._sinks:
+                        self._sinks.remove(sink)
+                    self._sink_errors.pop(id(sink), None)
+            else:
+                self._sink_errors.pop(id(sink), None)
 
     # -- spans --------------------------------------------------------- #
     def begin(self, track: str, name: str) -> None:

@@ -175,6 +175,14 @@ def heal_spec(spec: CommSpec, dead_mask) -> CommSpec:
     return Topology.from_weight_matrix(W)
 
 
+# the last (n_specs, dead-index tuple) recorded into the flight
+# recorder: healed_comm_weights runs on EVERY weight render, so the
+# healing plane records a decision only when the excised set actually
+# changes — a re-render of the same heal is data delivery, not a new
+# decision
+_last_healed_recorded = None
+
+
 def healed_comm_weights(specs: Sequence[CommSpec], dead_mask) -> tuple:
     """The healed schedule as traced-operand DATA: one
     ``(class_weights, self_weights)`` jnp pair per round, structurally
@@ -183,6 +191,18 @@ def healed_comm_weights(specs: Sequence[CommSpec], dead_mask) -> tuple:
     are excised without a recompile."""
     import jax.numpy as jnp
 
+    global _last_healed_recorded
+    dead = np.asarray(dead_mask, bool).reshape(-1)
+    key = (len(specs), tuple(int(i) for i in np.flatnonzero(dead)))
+    if key != _last_healed_recorded and (
+            dead.any() or _last_healed_recorded is not None):
+        _last_healed_recorded = key
+        from bluefog_tpu.observe import blackbox as _blackbox
+
+        _blackbox.record_decision(
+            "healing", "replan", step=-1,
+            telemetry={"dead": list(key[1]), "rounds": len(specs),
+                       "size": int(dead.shape[0])})
     out = []
     for s in specs:
         cw, sw = heal_weights(s, dead_mask)

@@ -282,6 +282,14 @@ def run_resilient(
 
     events: List[ResilienceEvent] = []
 
+    # the subset of loop events that are control DECISIONS (state
+    # transitions with a cause), mirrored into the blackbox flight
+    # recorder; high-rate telemetry kinds (skip, straggler, checkpoint)
+    # stay out of the ring
+    _decision_kinds = frozenset(
+        ("rollback", "rank_dead", "rank_join_failed",
+         "bad_window_unattributed"))
+
     def emit(kind: str, step: int, **detail):
         ev = ResilienceEvent(kind, step, detail)
         events.append(ev)
@@ -293,6 +301,11 @@ def run_resilient(
                 "resilience control-loop events", kind=kind).inc()
             observe.get_tracer().instant(f"resilience.{kind}",
                                          track="resilience")
+        if kind in _decision_kinds:
+            from bluefog_tpu.observe import blackbox as _blackbox
+
+            _blackbox.record_decision("resilience", kind, step=step,
+                                      detail=detail or None)
         if on_event is not None:
             on_event(ev)
 
@@ -339,6 +352,11 @@ def run_resilient(
     promoted_at: dict = {}
 
     while step < steps:
+        if controller is not None:
+            # stamp the loop step so membership decisions (admit /
+            # promote / kick / mark_dead) land at the right step in
+            # the flight recorder's causal chains
+            controller.current_step = step
         if controller is not None and admit_fn is not None:
             wanting = [int(r) for r in admit_fn(step)
                        if controller.is_dead(int(r))]
@@ -500,6 +518,7 @@ def run_resilient(
             params, opt_state = state["params"], state["opt_state"]
             restored_step = int(state["step"])
             if controller is not None:
+                controller.current_step = step
                 controller.mark_dead(newly)
                 for r in newly:
                     promoted_at.pop(r, None)

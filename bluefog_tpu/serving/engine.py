@@ -739,6 +739,12 @@ class ServingEngine:
                 handoff(req)
                 summary["handed_off"] += 1
         summary["flushed_chunks"] = self._drain_flushed
+        from bluefog_tpu.observe.blackbox import record_decision
+
+        record_decision(
+            "serving", "drain", step=-1,
+            telemetry={k: int(v) for k, v in sorted(summary.items())},
+            winner="handoff" if handoff is not None else "complete")
         return summary
 
     def _build_resident(self) -> Dict[str, tuple]:

@@ -193,7 +193,8 @@ class FleetRouter:
                  cooldown_s: Optional[float] = None,
                  cooldown_after: int = 3, seed: int = 0,
                  clock: Optional[Callable[[], float]] = None,
-                 sleep: Optional[Callable[[float], None]] = None):
+                 sleep: Optional[Callable[[float], None]] = None,
+                 blackbox=None):
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
         self.engines = list(engines)
@@ -251,6 +252,26 @@ class FleetRouter:
         self._cooldown_until = [float("-inf")] * n
         self.n_routed = 0
         self.n_saturated = 0
+        # decision flight recorder: poll count is the router's "step"
+        # (it has no training step), excision events parent their
+        # eventual readmission, and only CHANGES record — a steady
+        # excised set costs nothing per poll.
+        self.blackbox = blackbox
+        self._n_polls = 0
+        self._excised_prev = np.zeros(n, bool)
+        self._excise_events: Dict[int, object] = {}
+
+    def _decide(self, kind: str, *, parent=None, telemetry=None,
+                winner=None, **detail):
+        """The one blackbox emission seam of the router plane (the
+        ``decision-outside-recorder`` lint rule holds excision,
+        cooldown, and saturation decisions to it)."""
+        from bluefog_tpu.observe import blackbox as _blackbox
+
+        return _blackbox.record_decision(
+            "router", kind, step=self._n_polls, parent=parent,
+            telemetry=telemetry, winner=winner, blackbox=self.blackbox,
+            detail=detail or None)
 
     # -- gossip --------------------------------------------------------- #
     def _scrape(self):
@@ -304,6 +325,24 @@ class FleetRouter:
             rounds, spread = agg.rounds, agg.spread
         scores = self._score(signals)
         scores = np.where(excised, np.inf, scores)
+        self._n_polls += 1
+        if not np.array_equal(excised, self._excised_prev):
+            for i in np.flatnonzero(excised & ~self._excised_prev):
+                i = int(i)
+                ev = self._decide(
+                    "excise", winner=str(i),
+                    telemetry={"replica": i, "dead": bool(dead[i]),
+                               "suspect": bool(suspect[i]),
+                               "age": float(ages[i])})
+                if ev is not None:
+                    self._excise_events[i] = ev
+            for i in np.flatnonzero(self._excised_prev & ~excised):
+                i = int(i)
+                self._decide(
+                    "readmit", winner=str(i),
+                    parent=self._excise_events.pop(i, None),
+                    telemetry={"replica": i})
+            self._excised_prev = excised.copy()
         order = tuple(int(i) for i in np.lexsort(
             (np.arange(n), scores)))  # score, then index — deterministic
         return RouterSnapshot(signals=signals, scores=scores,
@@ -374,12 +413,22 @@ class FleetRouter:
                     self._fail_count[i] += 1
                     if (self.cooldown_s > 0 and self._fail_count[i]
                             >= self.cooldown_after):
+                        if self._fail_count[i] == self.cooldown_after:
+                            self._decide(
+                                "cooldown", winner=str(i),
+                                telemetry={"replica": i,
+                                           "fails": self._fail_count[i]})
                         self._cooldown_until[i] = now + self.cooldown_s
                     continue
                 self._fail_count[i] = 0
                 self.n_routed += 1
                 return i, request
         self.n_saturated += 1
+        self._decide(
+            "saturated",
+            telemetry={"depths": [int(d) for d in depths],
+                       "max_queue": int(max_queue)},
+            rejections=len(causes))
         raise FleetSaturated(depths, max_queue, causes=causes)
 
     # -- observability -------------------------------------------------- #

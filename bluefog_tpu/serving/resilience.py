@@ -188,4 +188,10 @@ def failover_stranded(engine, resubmit: Callable[[Request], object], *,
         req.reset_for_resume()
         resubmit(req)
         moved.append(req)
+    if stranded:
+        from bluefog_tpu.observe.blackbox import record_decision
+
+        record_decision(
+            "serving", "failover", step=-1,
+            telemetry={"moved": len(moved), "expired": len(expired)})
     return moved, expired

@@ -35,7 +35,7 @@ import numpy as np
 
 from bluefog_tpu.sim.clock import VirtualClock
 
-__all__ = ["EventLog", "Simulation", "format_event"]
+__all__ = ["EventLog", "Simulation", "canonical_detail", "format_event"]
 
 
 def _fmt_value(v) -> str:
@@ -52,6 +52,27 @@ def _fmt_value(v) -> str:
     return str(v)
 
 
+def canonical_detail(**detail) -> str:
+    """The sorted-key ``k=v`` tail of the canonical event rendering,
+    with every value through :func:`_fmt_value` (``%.9g`` floats).
+    Nested dicts canonicalize recursively as ``{k=v ...}`` and
+    lists/tuples as ``[v ...]``, so a telemetry snapshot digests
+    byte-stably too.  Shared by :func:`format_event` and the decision
+    flight recorder (:mod:`bluefog_tpu.observe.blackbox`), which must
+    agree on what "byte-stable" means."""
+
+    def render(v) -> str:
+        if isinstance(v, dict):
+            inner = " ".join(
+                f"{k}={render(v[k])}" for k in sorted(v, key=str))
+            return "{" + inner + "}"
+        if isinstance(v, (list, tuple)):
+            return "[" + " ".join(render(x) for x in v) + "]"
+        return _fmt_value(v)
+
+    return " ".join(f"{k}={render(detail[k])}" for k in sorted(detail))
+
+
 def format_event(t: float, kind: str, actor: str = "", **detail) -> str:
     """The canonical one-line event rendering:
     ``<t sec> <kind> <actor> k=v ...`` with detail keys sorted — the
@@ -59,8 +80,8 @@ def format_event(t: float, kind: str, actor: str = "", **detail) -> str:
     parts = [format(float(t), ".9f"), str(kind)]
     if actor:
         parts.append(str(actor))
-    for k in sorted(detail):
-        parts.append(f"{k}={_fmt_value(detail[k])}")
+    if detail:
+        parts.append(canonical_detail(**detail))
     return " ".join(parts)
 
 

@@ -367,7 +367,7 @@ class SimServingFleet:
                  sim: Optional[Simulation] = None,
                  fault_plan=None,
                  router=None, router_kwargs: Optional[dict] = None,
-                 poll_every: int = 1):
+                 poll_every: int = 1, blackbox=None):
         from bluefog_tpu.serving.fleet import FleetRouter
 
         if not replicas:
@@ -392,6 +392,7 @@ class SimServingFleet:
             kw.setdefault("clock", self.clock)
             # seeded-backoff sleeps burn VIRTUAL seconds
             kw.setdefault("sleep", self.clock.advance)
+            kw.setdefault("blackbox", blackbox)
             router = FleetRouter(self.replicas, **kw)
         self.router = router
         # scrape cadence in ticks: 1 re-polls every arrival tick (the
@@ -400,10 +401,18 @@ class SimServingFleet:
         # batch idiom, and what makes a million-request trace cheap
         # (the scrape's percentile walk is the sim's hot path)
         self.poll_every = int(poll_every)
+        self.blackbox = blackbox
         self.tick = 0
         self.polls = 0
         self.lost = 0
         self.failovers = 0
+
+    def _decide(self, kind, **detail):
+        from bluefog_tpu.observe import blackbox as _blackbox
+
+        return _blackbox.record_decision(
+            "sim_serving", kind, step=self.tick,
+            blackbox=self.blackbox, detail=detail or None)
 
     # -- fleet views ---------------------------------------------------- #
     def dead_mask(self) -> np.ndarray:
@@ -454,10 +463,13 @@ class SimServingFleet:
             except RequestRejected:
                 self.lost += 1
                 self.log.record(self.clock.t, "lost", rid=req.rid)
+                self._decide("lost", rid=int(req.rid), replica=r.name)
             else:
                 self.failovers += 1
                 self.log.record(self.clock.t, "failover",
                                 self.replicas[j].name, rid=req.rid)
+                self._decide("failover", rid=int(req.rid),
+                             to=self.replicas[j].name)
 
     # -- the run loop --------------------------------------------------- #
     def run(self, trace, *, max_ticks: Optional[int] = None) -> dict:
@@ -494,6 +506,7 @@ class SimServingFleet:
                     except RequestRejected:
                         self.lost += 1
                         self.log.record(self.clock.t, "lost", rid=i)
+                        self._decide("lost", rid=int(i))
                     else:
                         self.log.record(self.clock.t, "route",
                                         self.replicas[j].name, rid=i)

@@ -129,6 +129,10 @@ class SimTrainingFleet:
         self.weight_renders += 1
 
     def _apply_churn(self, step: int) -> None:
+        if self.membership is not None:
+            # stamp the virtual step so membership decisions land at
+            # the right step in the flight recorder's causal chains
+            self.membership.current_step = step
         for a in self.churn.at(step):
             if self.membership is not None:
                 if a.action == "die":

@@ -218,7 +218,8 @@ class TopologyControlPlane:
                  health_fn: Optional[Callable] = None,
                  initial: Optional[Sequence[DynamicTopology]] = None,
                  mix_ratios: Optional[Sequence[float]] = None,
-                 mix_recover_windows: int = 2):
+                 mix_recover_windows: int = 2,
+                 blackbox=None):
         carrier = tuple(carrier)
         if not carrier:
             raise ValueError("control plane needs a non-empty carrier "
@@ -288,7 +289,7 @@ class TopologyControlPlane:
         self._active_name = "carrier" if initial is None else "initial"
         self._previous: Optional[Tuple[DynamicTopology, ...]] = None
         self._previous_name = ""
-        self._pending = None           # (name, projected specs, score dict)
+        self._pending = None  # (name, projected specs, score, ready event)
         self._dead = np.zeros(n, bool)
         self._degraded_streak = 0
         self._membership_pending = False
@@ -320,6 +321,17 @@ class TopologyControlPlane:
         self.mix_rollbacks = 0
         self.a2a_replans = 0
         self.last_scores: Dict[str, float] = {}
+        # decision flight recorder (observe.blackbox).  ``None``
+        # records to the process-global ring gated by BLUEFOG_BLACKBOX;
+        # an explicit BlackBox records unconditionally; ``False``
+        # disables recording (the transparency-check "off" arm).
+        # The ``*_event`` fields thread the causal chain: a trigger
+        # parents its synthesis, an accepted candidate parents its
+        # swap, a swap parents its probation verdict.
+        self._blackbox = blackbox
+        self._trigger_event = None
+        self._swap_event = None
+        self._mix_event = None
 
     # ------------------------------------------------------------ #
     # read-side surface
@@ -376,6 +388,12 @@ class TopologyControlPlane:
         with self._lock:
             self._a2a_plan = plan
             self.a2a_replans += 1
+            self._decide(
+                "a2a", "replan", step=self._steps_seen,
+                parent=self._trigger_event,
+                winner=getattr(plan, "name", None),
+                calibrated=pod is not self.pod,
+                replans=self.a2a_replans)
         return plan
 
     # ------------------------------------------------------------ #
@@ -558,12 +576,16 @@ class TopologyControlPlane:
         z_hot = max(z.values(), default=0.0) >= self._z_threshold
         return (worst >= self.degrade_ratio or z_hot), worst
 
-    def _calibrated_pod(self, secs: Dict[tuple, float],
-                        z: Dict[int, float]) -> PodSpec:
-        """The window's re-priced pod: seconds deltas routed into link
-        cost multipliers, plus synthetic load on every active edge
-        incident to a flagged straggler (slow rank => expensive
-        links => synthesis routes around it)."""
+    def _calibration_traffic(self, secs: Dict[tuple, float],
+                             z: Dict[int, float],
+                             ) -> Dict[Tuple[int, int], float]:
+        """The per-edge traffic the window's re-pricing feeds into
+        :meth:`PodSpec.calibrated`: seconds deltas, plus synthetic load
+        on every active edge incident to a flagged straggler (slow
+        rank => expensive links => synthesis routes around it).  Pure
+        given ``(secs, z)`` and the current active edge set — the
+        decision recorder snapshots this dict so replay never has to
+        reconstruct the activation state."""
         n = self.pod.size
         traffic = {k: float(v) for k, v in secs.items()
                    if 0 <= k[0] < n and 0 <= k[1] < n}
@@ -575,16 +597,122 @@ class TopologyControlPlane:
                     if r in e:
                         traffic[e] = (traffic.get(e, 0.0)
                                       + base * z[r] / self._z_threshold)
+        return traffic
+
+    def _pod_from_traffic(self, traffic: Dict[Tuple[int, int], float],
+                          ) -> PodSpec:
         if not traffic:
             return self.pod
         return self.pod.calibrated(traffic, contention=self._contention)
 
+    def _calibrated_pod(self, secs: Dict[tuple, float],
+                        z: Dict[int, float]) -> PodSpec:
+        """The window's re-priced pod (see
+        :meth:`_calibration_traffic`)."""
+        return self._pod_from_traffic(self._calibration_traffic(secs, z))
+
+    # ------------------------------------------------------------ #
+    # decision flight recorder
+    # ------------------------------------------------------------ #
+    def _decide(self, plane: str, kind: str, *, step: int, parent=None,
+                telemetry=None, candidates=None, winner=None,
+                winner_cost=None, margin=None, **detail):
+        """The one blackbox emission seam of this plane (the
+        ``decision-outside-recorder`` lint rule holds every transition
+        to it).  Returns the recorded event or ``None`` when the
+        recorder is off — callers thread ``None`` parents through."""
+        from bluefog_tpu.observe import blackbox as _blackbox
+
+        return _blackbox.record_decision(
+            plane, kind, step=step, parent=parent, telemetry=telemetry,
+            candidates=candidates, winner=winner,
+            winner_cost=winner_cost, margin=margin,
+            blackbox=self._blackbox, detail=detail or None)
+
+    def _telemetry_snapshot(self, reason: str,
+                            secs: Dict[tuple, float],
+                            z: Dict[int, float],
+                            dead: np.ndarray,
+                            traffic: Dict[Tuple[int, int], float],
+                            ) -> dict:
+        """The canonical (digestable, replayable) record of everything
+        a trigger saw: windowed edge-seconds deltas, straggler
+        z-scores, the dead set, the derived calibration traffic, and
+        the incumbent's name.  Keys are strings so the snapshot
+        round-trips through JSONL dumps unchanged."""
+        return {
+            "reason": str(reason),
+            "incumbent": self._active_name,
+            "secs": {f"{a}-{b}": float(v)
+                     for (a, b), v in sorted(secs.items())},
+            "z": {str(r): float(v) for r, v in sorted(z.items())},
+            "dead": [int(i) for i in np.flatnonzero(dead)],
+            "traffic": {f"{a}-{b}": float(v)
+                        for (a, b), v in sorted(traffic.items())},
+        }
+
+    def replay_decision(self, event, schedules) -> Dict[str, object]:
+        """Re-derive a recorded ``synthesize`` decision from its OWN
+        telemetry snapshot: rebuild the calibrated pod from the
+        recorded traffic, re-project and re-score every recorded
+        candidate (``schedules`` maps candidate/incumbent names back to
+        their schedules), and return the winner/cost/margin that fall
+        out.  The fleet-sim replay-verification pass machine-checks
+        these against the event's recorded fields — "the fleet's
+        decisions are reproducible from its own audit log"."""
+        tele = event.telemetry
+        traffic = {tuple(int(x) for x in k.split("-")): float(v)
+                   for k, v in tele.get("traffic", {}).items()}
+        pod = self._pod_from_traffic(traffic)
+        dead = np.zeros(self.pod.size, bool)
+        for i in tele.get("dead", ()):
+            dead[int(i)] = True
+        inc_name = tele.get("incumbent")
+        costs: Dict[str, float] = {}
+        for key in (event.candidates or {}):
+            name = inc_name if key == "incumbent" else key
+            proj = self.project(schedules[name])
+            costs[key] = self.score_active(
+                proj, dead, pod)["cost_to_consensus"]
+        ranked = [k for k in costs if k != "incumbent"]
+        if not ranked:
+            return {"winner": None, "winner_cost": None,
+                    "margin": None, "costs": costs}
+        best = ranked[0]
+        for k in ranked[1:]:
+            if costs[k] < costs[best]:
+                best = k
+        inc = costs.get("incumbent")
+        margin = (1.0 - costs[best] / inc
+                  if inc is not None and inc > 0.0 else None)
+        return {"winner": best, "winner_cost": costs[best],
+                "margin": margin, "costs": costs}
+
+    def replay_mix_decision(self, event) -> Dict[str, object]:
+        """Re-derive a recorded mix-ladder move from its telemetry:
+        the ladder is a fixed menu, so the "scoring" is the rung
+        arithmetic — ``degraded`` steps down (coarser, fewer wire
+        bytes), anything else steps up toward the build ratio."""
+        tele = event.telemetry
+        ladder = [float(r) for r in tele.get("ladder", ())]
+        frm = int(tele["from_index"])
+        to = frm + 1 if tele.get("reason") == "degraded" else frm - 1
+        if not 0 <= to < len(ladder):
+            return {"winner": None, "winner_cost": None, "to_index": to}
+        return {"winner": format(ladder[to], ".9g"),
+                "winner_cost": ladder[to], "to_index": to}
+
     # ------------------------------------------------------------ #
     # synthesis (background or inline)
     # ------------------------------------------------------------ #
-    def _synthesize(self, pod: PodSpec, dead: np.ndarray) -> None:
+    def _synthesize(self, pod: PodSpec, dead: np.ndarray,
+                    step: Optional[int] = None,
+                    telemetry: Optional[dict] = None,
+                    trigger_ev=None) -> None:
         gen = self._candidates_fn or self._default_candidates
         incumbent = self.score_active(self._active, dead, pod)
+        inc_cost = incumbent["cost_to_consensus"]
+        scored: Dict[str, float] = {"incumbent": inc_cost}
         best = None
         for name, sched in gen(pod, dead):
             try:
@@ -594,23 +722,43 @@ class TopologyControlPlane:
             sc = self.score_active(proj, dead, pod)
             if not math.isfinite(sc["cost_to_consensus"]):
                 continue
+            scored[name] = sc["cost_to_consensus"]
             if best is None or (sc["cost_to_consensus"]
                                 < best[2]["cost_to_consensus"]):
                 best = (name, proj, sc)
+        achieved = (1.0 - best[2]["cost_to_consensus"] / inc_cost
+                    if best is not None and inc_cost > 0.0 else None)
+        step = self._steps_seen if step is None else step
         with self._lock:
             self.last_scores = {
-                "incumbent": incumbent["cost_to_consensus"],
+                "incumbent": inc_cost,
                 "candidate": (best[2]["cost_to_consensus"]
                               if best else float("inf")),
             }
-            bar = incumbent["cost_to_consensus"] * (1.0 - self.margin)
+            synth_ev = self._decide(
+                "topology", "synthesize", step=step, parent=trigger_ev,
+                telemetry=telemetry, candidates=scored,
+                winner=best[0] if best else None,
+                winner_cost=best[2]["cost_to_consensus"] if best else None,
+                margin=achieved)
+            bar = inc_cost * (1.0 - self.margin)
             if best is not None and best[2]["cost_to_consensus"] < bar:
-                self._pending = best
+                ready_ev = self._decide(
+                    "topology", "candidate_ready", step=step,
+                    parent=synth_ev, winner=best[0],
+                    winner_cost=best[2]["cost_to_consensus"])
+                self._pending = (best[0], best[1], best[2], ready_ev)
                 self._state = CANDIDATE_READY
             else:
+                self._decide(
+                    "topology", "reject", step=step, parent=synth_ev,
+                    winner=best[0] if best else None,
+                    winner_cost=(best[2]["cost_to_consensus"]
+                                 if best else None),
+                    margin=achieved, bar=bar)
                 self._async_events.append(("topology_reject", {
                     "reason": "margin",
-                    "incumbent": incumbent["cost_to_consensus"],
+                    "incumbent": inc_cost,
                     "best": (best[2]["cost_to_consensus"]
                              if best else None),
                     "candidate": best[0] if best else None,
@@ -624,11 +772,39 @@ class TopologyControlPlane:
         """Queue ``schedule`` for the next step boundary, bypassing the
         margin gate (projection is still enforced — an unexpressible
         plan raises).  The chaos bench uses this to inject a known-bad
-        candidate and machine-check that probation rolls it back."""
+        candidate and machine-check that probation rolls it back.
+        The forced path records the same trigger→synthesize→
+        candidate_ready chain a telemetry trigger would, so the audit
+        trail of the injected swap reads like any other."""
         proj = self.project(schedule)
         with self._lock:
+            step = self._steps_seen
+            incumbent = self.score_active(self._active, self._dead)
             sc = self.score_active(proj, self._dead)
-            self._pending = (name, proj, sc)
+            inc_cost = incumbent["cost_to_consensus"]
+            achieved = (1.0 - sc["cost_to_consensus"] / inc_cost
+                        if inc_cost > 0.0 else None)
+            self.last_scores = {
+                "incumbent": inc_cost,
+                "candidate": sc["cost_to_consensus"],
+            }
+            tele = self._telemetry_snapshot(
+                "forced", {}, {}, self._dead, {})
+            trig_ev = self._decide(
+                "topology", "trigger", step=step, telemetry=tele)
+            synth_ev = self._decide(
+                "topology", "synthesize", step=step, parent=trig_ev,
+                telemetry=tele,
+                candidates={"incumbent": inc_cost,
+                            name: sc["cost_to_consensus"]},
+                winner=name, winner_cost=sc["cost_to_consensus"],
+                margin=achieved)
+            ready_ev = self._decide(
+                "topology", "candidate_ready", step=step,
+                parent=synth_ev, winner=name,
+                winner_cost=sc["cost_to_consensus"])
+            self._trigger_event = trig_ev
+            self._pending = (name, proj, sc, ready_ev)
             self._state = CANDIDATE_READY
 
     # ------------------------------------------------------------ #
@@ -670,6 +846,13 @@ class TopologyControlPlane:
                         self._cooldown_until = step + self.cooldown
                         self.rollbacks += 1
                         self._count("rollback")
+                        self._decide(
+                            "topology", "rollback", step=step,
+                            parent=self._swap_event,
+                            winner=self._active_name,
+                            health=health,
+                            preswap_health=self._preswap_health)
+                        self._swap_event = None
                         events.append(("topology_rollback", {
                             "restored": self._active_name,
                             "health": health,
@@ -682,6 +865,11 @@ class TopologyControlPlane:
                     self._degraded_streak = 0
                     self._cooldown_until = step + self.cooldown
                     self._count("commit")
+                    self._decide(
+                        "topology", "commit", step=step,
+                        parent=self._swap_event,
+                        winner=self._active_name)
+                    self._swap_event = None
                     events.append(("topology_commit",
                                    {"schedule": self._active_name}))
                 return events
@@ -705,6 +893,13 @@ class TopologyControlPlane:
                         self._cooldown_until = step + self.cooldown
                         self.mix_rollbacks += 1
                         self._count("mix_rollback")
+                        self._decide(
+                            "mix", "rollback", step=step,
+                            parent=self._mix_event,
+                            winner=format(self.mix_ratios[restored],
+                                          ".9g"),
+                            health=health, preswap_health=preswap)
+                        self._mix_event = None
                         events.append(("mix_ratio_rollback", {
                             "restored": self.mix_ratios[restored],
                             "ratio": self.mix_ratios[bad],
@@ -718,11 +913,17 @@ class TopologyControlPlane:
                     self._mix_preswap_health = None
                     self._cooldown_until = step + self.cooldown
                     self._count("mix_commit")
+                    self._decide(
+                        "mix", "commit", step=step,
+                        parent=self._mix_event,
+                        winner=format(self.mix_ratios[self._mix_index],
+                                      ".9g"))
+                    self._mix_event = None
                     events.append(("mix_ratio_commit", {
                         "ratio": self.mix_ratios[self._mix_index]}))
                 return events
             if state == CANDIDATE_READY and self._pending is not None:
-                name, proj, sc = self._pending
+                name, proj, sc, ready_ev = self._pending
                 self._pending = None
                 self._previous = self._active
                 self._previous_name = self._active_name
@@ -735,6 +936,10 @@ class TopologyControlPlane:
                 self._probation_end = step + self.probation
                 self.swaps += 1
                 self._count("swap")
+                self._swap_event = self._decide(
+                    "topology", "swap", step=step, parent=ready_ev,
+                    winner=name,
+                    winner_cost=sc["cost_to_consensus"])
                 events.append(("topology_swap", {
                     "schedule": name,
                     "cost_to_consensus": sc["cost_to_consensus"],
@@ -794,7 +999,8 @@ class TopologyControlPlane:
                 return events
             self._degraded_streak = 0
             self._state = SYNTHESIZING
-            pod_w = self._calibrated_pod(secs, z)
+            traffic = self._calibration_traffic(secs, z)
+            pod_w = self._pod_from_traffic(traffic)
             # the a2a planner prices against the same window's costs;
             # stale any cached dispatch schedule so it re-plans lazily
             self._last_calibrated_pod = pod_w
@@ -802,12 +1008,18 @@ class TopologyControlPlane:
             dead_now = self._dead.copy()
             self.triggers += 1
             self._count("trigger")
+            tele = self._telemetry_snapshot(
+                reason, secs, z, dead_now, traffic)
+            trig_ev = self._decide(
+                "topology", "trigger", step=step, telemetry=tele)
+            self._trigger_event = trig_ev
             events.append(("topology_trigger", {"reason": reason}))
         if self.synchronous:
-            self._synthesize(pod_w, dead_now)
+            self._synthesize(pod_w, dead_now, step, tele, trig_ev)
         else:
             self._thread = threading.Thread(
-                target=self._synthesize, args=(pod_w, dead_now),
+                target=self._synthesize,
+                args=(pod_w, dead_now, step, tele, trig_ev),
                 name="bf-topology-replan", daemon=True)
             self._thread.start()
         return events
@@ -828,6 +1040,15 @@ class TopologyControlPlane:
         self._degraded_streak = 0
         self.mix_swaps += 1
         self._count("mix_swap")
+        self._mix_event = self._decide(
+            "mix", "swap", step=step,
+            telemetry={"reason": reason, "from_index": prev,
+                       "to_index": to_index,
+                       "ladder": [float(r) for r in self.mix_ratios]},
+            candidates={format(r, ".9g"): float(r)
+                        for r in self.mix_ratios},
+            winner=format(self.mix_ratios[to_index], ".9g"),
+            winner_cost=float(self.mix_ratios[to_index]))
         events.append(("mix_ratio_swap", {
             "ratio": self.mix_ratios[to_index],
             "previous": self.mix_ratios[prev],

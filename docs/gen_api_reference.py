@@ -91,6 +91,8 @@ MODULES = [
      "exporters: Prometheus text, JSONL events, Chrome trace, snapshot"),
     ("bluefog_tpu.observe.fleet",
      "fleet telemetry: push-sum metric gossip, edge traffic, stragglers"),
+    ("bluefog_tpu.observe.blackbox",
+     "decision flight recorder: causal audit ring, replay, explain CLI"),
     ("bluefog_tpu.parallel.collectives",
      "XLA collective data plane (mesh ops)"),
     ("bluefog_tpu.parallel.ring_attention", "ring/blockwise attention (SP)"),

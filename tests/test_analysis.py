@@ -510,6 +510,68 @@ def test_sim_package_has_no_wallclock_reads():
         assert [f for f in fs if f.rule == "wallclock-in-sim"] == [], rel
 
 
+def test_rule_decision_outside_recorder(tmp_path):
+    """A control-plane transition method that never emits through the
+    decision flight recorder is flagged; one that calls
+    record_decision (or delegates to a _decide wrapper, directly or in
+    a nested helper) passes.  The rule is keyed on the sanctioned
+    method registry — fixtures opt in via plane_methods=."""
+    fs = _lint_src(tmp_path, """
+        class Plane:
+            def on_step(self, step):
+                self.state = 'SWAPPED'
+                return step
+            def admit(self, rank):
+                self.members.add(rank)
+        """, plane_methods={"on_step", "admit"}, **_PKG)
+    assert [f.rule for f in fs] == ["decision-outside-recorder"] * 2
+    assert sorted(f.symbol for f in fs) == ["admit", "on_step"]
+    assert "record_decision" in fs[0].message
+    # emitting through the API (or a _decide wrapper, even from a
+    # nested helper) satisfies the rule
+    assert _lint_src(tmp_path, """
+        from bluefog_tpu.observe.blackbox import record_decision
+        class Plane:
+            def on_step(self, step):
+                record_decision('topology', 'swap', step=step)
+            def admit(self, rank):
+                self._decide('membership', 'admit', rank)
+            def kick(self, rank):
+                def _emit():
+                    return self._decide('membership', 'kick', rank)
+                return _emit()
+        """, plane_methods={"on_step", "admit", "kick"}, **_PKG) == []
+    # methods outside the sanctioned set stay dormant, and a file with
+    # no registry entry (plane_methods defaults empty) is never flagged
+    assert _lint_src(tmp_path, """
+        class Plane:
+            def helper(self, step):
+                return step
+        """, plane_methods={"on_step"}, **_PKG) == []
+    assert _lint_src(tmp_path, """
+        class Plane:
+            def on_step(self, step):
+                self.state = 'SWAPPED'
+        """, **_PKG) == []
+
+
+def test_decision_plane_registry_live_on_real_tree():
+    """The sanctioned-callsite registry is live: every registered
+    control-plane transition in the real tree emits through the
+    recorder (zero decision-outside-recorder findings), and the
+    registry names only methods that actually exist — a renamed
+    transition must update the registry, not silently drop out."""
+    for rel, methods in sorted(L._DECISION_PLANE_METHODS.items()):
+        path = os.path.join(_REPO, rel)
+        assert os.path.exists(path), rel
+        src = open(path).read()
+        for name in sorted(methods):
+            assert f"def {name}(" in src, f"{rel}: {name} missing"
+        fs = L.lint_file(path, rel, markers=set(), **_PKG)
+        bad = [f for f in fs if f.rule == "decision-outside-recorder"]
+        assert bad == [], "\n".join(f.render() for f in bad)
+
+
 def test_registered_markers_include_analysis():
     marks = L.registered_markers(_REPO)
     assert "analysis" in marks and "perf" in marks

@@ -412,20 +412,21 @@ def test_compressed_audit_baseline_is_committed_and_defended():
 # --------------------------------------------------------------------- #
 @pytest.mark.sim
 def test_fleet_sim_defaults_and_baseline():
-    """fleet_sim.py gates against the committed r18 artifact by
+    """fleet_sim.py gates against the committed r20 artifact by
     default; ``--compare ''`` opts out; the committed record passed
     every machine-checked claim: congested-link trigger->swap->commit
     at n=1024, the preempted rank round-tripped through the real
     membership controller, the straggler named, token-exact replica
-    failover mid-million-request trace, and flash-crowd backpressure
-    bounded."""
+    failover mid-million-request trace, flash-crowd backpressure
+    bounded, and (r20) every recorded decision replayed to the same
+    winner/cost/margin with a deterministic chain digest."""
     fs = _load_bench_module("fleet_sim")
     args = fs.parse_args([])
     assert args.compare == fs.DEFAULT_BASELINE
     assert os.path.exists(args.compare)
     assert fs.parse_args(["--compare", ""]).compare is None
     assert fs.parse_args(["--compare", "x.json"]).compare == "x.json"
-    base = _load(os.path.join("benchmarks", "fleet_sim_r18.json"))
+    base = _load(os.path.join("benchmarks", "fleet_sim_r20.json"))
     assert all(base["checks"].values())
     assert base["sim_training"]["step_time_ratio"] < 0.9
     assert base["sim_training"]["detect_to_swap_s"] > 0
@@ -439,6 +440,17 @@ def test_fleet_sim_defaults_and_baseline():
     assert serve["requests"] == 1_000_000
     assert serve["failovers"] > 0
     assert serve["completed"] + serve["lost_requests"] == serve["requests"]
+    # r20: the flight recorder rode along — decisions were replayed
+    # against the recorded telemetry and every one re-scored to the
+    # same winner; two same-seed runs produced the same chain digest
+    assert base["replay"]["decisions_replayed"] >= 3
+    assert base["replay"]["mismatches"] == 0
+    replay = base["replay_detail"]
+    assert len(replay["decision_chain_digest"]) == 64
+    assert replay["train_decisions_recorded"] > 0
+    assert replay["mix_decisions_recorded"] > 0
+    assert replay["serve_decisions_retained"] <= fs.BLACKBOX_CAPACITY
+    assert replay["recorder_overhead_pct"] < 2.0
     from bluefog_tpu.benchutil import bench_headline
 
     head = bench_headline(base)
@@ -446,33 +458,41 @@ def test_fleet_sim_defaults_and_baseline():
     assert "sim_training.detect_to_swap_s" in head
     assert "sim_serving.tokens_per_sec" in head
     assert "sim_serving.lost_requests" in head
+    assert "replay.decisions_replayed" in head
+    assert "replay.mismatches" in head
 
 
 @pytest.mark.sim
 def test_gate_catches_sim_regression(capsys):
-    """A simulator change that slows detection, stops adapting, or
-    strands requests fails the gate: detect_to_swap_s and
-    step_time_ratio are lower-is-better, and lost_requests is pinned at
-    zero tolerance — even a single extra lost request regresses."""
+    """A simulator change that slows detection, stops adapting, strands
+    requests, or breaks decision replay fails the gate: detect_to_swap_s
+    and step_time_ratio are lower-is-better, and lost_requests and
+    replay.mismatches are pinned at zero tolerance — even a single extra
+    lost request or a single decision that re-scores to a different
+    winner regresses."""
     from bluefog_tpu.benchutil import bench_compare
 
-    base = _load(os.path.join("benchmarks", "fleet_sim_r18.json"))
+    base = _load(os.path.join("benchmarks", "fleet_sim_r20.json"))
     regressed = copy.deepcopy(base)
     regressed["sim_training"]["step_time_ratio"] = 1.0
     regressed["sim_training"]["detect_to_swap_s"] *= 3.0
     regressed["sim_serving"]["lost_requests"] += 1
+    regressed["replay"]["mismatches"] += 1
     ok, rows = bench_compare(
         regressed, base, tolerance=0.02,
-        tolerances={"sim_serving.lost_requests": 0.0})
+        tolerances={"sim_serving.lost_requests": 0.0,
+                    "replay.mismatches": 0.0})
     assert ok is False
     bad = {r["name"] for r in rows if r["regressed"]}
     assert "sim_training.step_time_ratio" in bad
     assert "sim_training.detect_to_swap_s" in bad
     assert "sim_serving.lost_requests" in bad
+    assert "replay.mismatches" in bad
     # ... and the committed record gates clean against itself
     ok2, _ = bench_compare(base, base,
                            tolerances={
-                               "sim_serving.lost_requests": 0.0})
+                               "sim_serving.lost_requests": 0.0,
+                               "replay.mismatches": 0.0})
     assert ok2 is True
 
 

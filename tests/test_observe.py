@@ -865,3 +865,199 @@ def test_bench_compare_direction_and_tolerance(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "[bench-gate]" in out and "REGRESSED" in out
     assert out.count("\n") == 1  # ONE line
+
+def test_bench_gate_names_baseline_file_and_round(tmp_path, capsys):
+    """The gate line attributes the comparison: baseline path plus the
+    record round (filename ``_r<N>`` convention, explicit ``round``
+    field, else ``r?``)."""
+    from bluefog_tpu.benchutil import bench_regression_gate
+
+    prev_path = tmp_path / "fleet_sim_r20.json"
+    prev_path.write_text(json.dumps({"value": 1000.0}))
+    assert bench_regression_gate({"value": 1000.0}, str(prev_path))
+    out = capsys.readouterr().out
+    assert f"vs {prev_path} (r20):" in out
+    p2 = tmp_path / "baseline.json"
+    p2.write_text(json.dumps({"value": 1000.0, "round": 7}))
+    bench_regression_gate({"value": 995.0}, str(p2))
+    assert f"vs {p2} (r7):" in capsys.readouterr().out
+    p3 = tmp_path / "plain.json"
+    p3.write_text(json.dumps({"value": 1.0}))
+    bench_regression_gate({"value": 1.0}, str(p3))
+    assert "(r?):" in capsys.readouterr().out
+
+
+def test_bench_gate_no_shared_metrics_lists_sections(tmp_path, capsys):
+    """Comparing records with disjoint headline sections names BOTH
+    sides' sections (the 'you gated serving against training' case)
+    instead of silently passing with an empty table."""
+    from bluefog_tpu.benchutil import bench_regression_gate
+
+    prev_path = tmp_path / "serving_r3.json"
+    prev_path.write_text(json.dumps(
+        {"continuous": {"tokens_per_sec": 1.0},
+         "static": {"tokens_per_sec": 2.0}}))
+    current = {"sim_training": {"p50": 0.01},
+               "replay": {"mismatches": 0.0}}
+    assert bench_regression_gate(current, str(prev_path))
+    out = capsys.readouterr().out
+    assert "no shared headline metrics" in out
+    assert f"{prev_path} (r3)" in out
+    assert "current sections [replay,sim_training]" in out
+    assert "baseline sections [continuous,static]" in out
+    assert out.count("\n") == 1  # still ONE line
+
+
+def test_bench_headline_replay_section():
+    """The replay-verification section gates: decisions_replayed is
+    higher-better, mismatches lower-better."""
+    from bluefog_tpu.benchutil import bench_compare, bench_headline
+
+    rec = {"replay": {"decisions_replayed": 6.0, "mismatches": 0.0}}
+    assert bench_headline(rec) == {"replay.decisions_replayed": 6.0,
+                                   "replay.mismatches": 0.0}
+    ok, rows = bench_compare(
+        {"replay": {"decisions_replayed": 6.0, "mismatches": 1.0}},
+        rec, tolerances={"replay.mismatches": 0.0})
+    assert not ok
+    assert [r["name"] for r in rows if r["regressed"]] == \
+        ["replay.mismatches"]
+
+
+# --------------------------------------------------------------------- #
+# tracer sink hardening
+# --------------------------------------------------------------------- #
+class _BoomSink:
+    def __init__(self):
+        self.calls = 0
+
+    def record(self, name, tid, phase):
+        self.calls += 1
+        raise RuntimeError("disk full")
+
+
+class _ListSink:
+    def __init__(self):
+        self.events = []
+
+    def record(self, name, tid, phase):
+        self.events.append((phase, name, tid))
+
+
+def test_tracer_broken_sink_detached_after_limit(monkeypatch):
+    """A persistently-failing sink is fault-isolated (other sinks and
+    the buffer see every event), counted, and detached after
+    SINK_ERROR_LIMIT consecutive failures."""
+    from bluefog_tpu.observe.tracer import SINK_ERROR_LIMIT
+
+    monkeypatch.setenv("BLUEFOG_OBSERVE", "1")
+    tr = Tracer()
+    boom, good = _BoomSink(), _ListSink()
+    ctr = observe.get_registry().counter(
+        "bf_tracer_sink_errors_total", sink="_BoomSink")
+    before = ctr.value
+    tr.add_sink(boom)
+    tr.add_sink(good)
+    n = SINK_ERROR_LIMIT + 3
+    for i in range(n):
+        tr.instant(f"e{i}")
+    assert boom.calls == SINK_ERROR_LIMIT  # detached, never called again
+    assert len(good.events) == n           # the good sink never starved
+    assert len(tr.events()) == n           # the buffer saw everything
+    assert ctr.value - before == SINK_ERROR_LIMIT
+
+
+def test_tracer_sink_error_streak_resets_on_success():
+    """Only CONSECUTIVE failures detach: a flaky sink that recovers
+    before the limit stays attached."""
+    from bluefog_tpu.observe.tracer import SINK_ERROR_LIMIT
+
+    class _Flaky:
+        def __init__(self):
+            self.calls = 0
+            self.failing = False
+
+        def record(self, name, tid, phase):
+            self.calls += 1
+            if self.failing:
+                raise RuntimeError("transient")
+
+    tr = Tracer()
+    flaky = _Flaky()
+    tr.add_sink(flaky)
+    for _ in range(3):  # each burst: LIMIT-1 failures, then a success
+        flaky.failing = True
+        for _ in range(SINK_ERROR_LIMIT - 1):
+            tr.instant("x")
+        flaky.failing = False
+        tr.instant("x")
+    total = 3 * SINK_ERROR_LIMIT
+    assert flaky.calls == total  # still attached through every burst
+    tr.instant("x")
+    assert flaky.calls == total + 1
+
+
+# --------------------------------------------------------------------- #
+# decision flight recorder: exposition + zero-cost toggle
+# --------------------------------------------------------------------- #
+def test_prometheus_exposition_blackbox_metrics(registry):
+    """Strict-parser pass over the recorder's metric families:
+    bf_decisions_total{plane,kind,outcome} counters and the
+    bf_blackbox_dropped_events gauge."""
+    from bluefog_tpu.observe.blackbox import BlackBox
+
+    bb = BlackBox(capacity=2, registry=registry)
+    trig = bb.record("topology", "trigger", step=0)
+    bb.record("topology", "commit", step=1, parent=trig)
+    bb.record("mix", "swap", step=2)  # overflows the 2-slot ring
+    text = observe.prometheus_text(registry)
+    fams = _strict_parse_prometheus(text)
+    assert fams["bf_decisions_total"]["type"] == "counter"
+    samples = fams["bf_decisions_total"]["samples"]
+    assert all(set(s[1]) == {"plane", "kind", "outcome"}
+               for s in samples)
+    by = {(s[1]["plane"], s[1]["kind"], s[1]["outcome"]): float(s[2])
+          for s in samples}
+    assert by[("topology", "trigger", "pending")] == 1.0
+    assert by[("topology", "commit", "committed")] == 1.0
+    assert by[("mix", "swap", "pending")] == 1.0
+    assert fams["bf_blackbox_dropped_events"]["type"] == "gauge"
+    (dropped,) = fams["bf_blackbox_dropped_events"]["samples"]
+    assert float(dropped[2]) == 1.0
+
+
+def test_blackbox_toggle_leaves_compiled_programs_untouched(monkeypatch):
+    """The recorder is host-side only: a control plane making recorded
+    decisions between jitted steps leaves jit cache sizes and step
+    outputs bit-identical with the recorder on vs off."""
+    from bluefog_tpu.observe.blackbox import BlackBox
+    from bluefog_tpu.topology import PodSpec, TopologyControlPlane
+    from bluefog_tpu.topology.dynamic import one_peer_dynamic_schedule
+
+    mesh = Mesh(np.array(jax.devices()[:N]), ("bf",))
+    step, params0, ostate0, batch = _bucketed_step(mesh)
+    carrier = list(one_peer_dynamic_schedule(N))[:2]
+
+    def run3(arm):
+        plane = TopologyControlPlane(
+            PodSpec(2, 4), carrier, synchronous=True, window=4,
+            probation=1, blackbox=arm)
+        plane.force_candidate(list(carrier), "forced")
+        p, o = params0, ostate0
+        for i in range(3):
+            plane.on_step(i)  # swap at 0, probation commit after
+            p, o, loss = step(p, o, batch, jnp.int32(i))
+        return p, loss
+
+    monkeypatch.setenv("BLUEFOG_BLACKBOX", "1")
+    bb = BlackBox(capacity=64)
+    p_on, loss_on = run3(bb)
+    size_on = step.jitted._cache_size()
+    assert bb.n_recorded >= 5  # trigger/synthesize/ready/swap/commit
+    monkeypatch.setenv("BLUEFOG_BLACKBOX", "0")
+    p_off, loss_off = run3(False)
+    assert step.jitted._cache_size() == size_on  # no recompiles
+    np.testing.assert_array_equal(np.asarray(loss_on),
+                                  np.asarray(loss_off))
+    for a, b in zip(jax.tree.leaves(p_on), jax.tree.leaves(p_off)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -482,6 +482,90 @@ def test_sim_vs_real_training_control_decisions_agree():
     assert wire2.charges == real_charges
 
 
+def test_decision_chain_real_vs_sim_byte_identical():
+    """A forced probation-rollback cycle records the same causal audit
+    chain — trigger→synthesize→candidate_ready→swap→rollback with
+    monotone steps and linked parents — in the REAL 8-rank
+    ``run_resilient`` loop and in the simulated fleet, and the two
+    recorders' chain digests are byte-identical (wall time and the
+    measured probation health ride the events as ``detail``, excluded
+    from the digested lines)."""
+    import tempfile
+
+    import jax
+    from jax.sharding import Mesh
+
+    from bluefog_tpu import resilience as R
+    from bluefog_tpu.checkpoint import Checkpointer
+    from bluefog_tpu.observe.blackbox import BlackBox
+    from bluefog_tpu.optim import functional as F
+    from bluefog_tpu.topology import TopologyControlPlane
+
+    bench = _load_bench_module("chaos_adaptive_topology")
+    steps = 6
+
+    def make_plane(bb):
+        # scripted probation health: baseline 1.0 at swap time, 10x on
+        # the first probation check — beyond rollback_tolerance
+        h = iter([1.0] + [10.0] * 32)
+        return TopologyControlPlane(
+            bench.make_pod(), bench.rich_carrier(), window=0,
+            probation=3, rollback_tolerance=1.2, synchronous=True,
+            health_fn=lambda params, alive: next(h), blackbox=bb)
+
+    # -- the REAL loop: jax training under run_resilient -------------- #
+    bb_real = BlackBox(capacity=256)
+    control = make_plane(bb_real)
+    control.force_candidate(list(bench.rich_carrier()), "frozen")
+    mesh = Mesh(np.array(jax.devices()[:bench.N]), ("bf",))
+    dim, width, xs, ys, loss_fn, opt = bench._training_setup(0)
+    step_g = F.build_train_step(loss_fn, opt, mesh, comm_mode="atc",
+                                schedule=control.carrier,
+                                guard=F.GuardConfig())
+    params, opt_state = bench._fresh(mesh, dim, width, opt)
+    with tempfile.TemporaryDirectory() as d:
+        ck = Checkpointer(d)
+        R.run_resilient(step_g, params, opt_state,
+                        lambda s: (xs[s % 64], ys[s % 64]), steps=steps,
+                        checkpointer=ck, mesh=mesh,
+                        schedule=control.carrier,
+                        detector=R.FailureDetector(bench.N),
+                        checkpoint_every=0, sleep=lambda s: None,
+                        control=control)
+        ck.close()
+
+    # -- the SIM twin: same plane construction, virtual time ---------- #
+    bb_sim = BlackBox(capacity=256)
+    control2 = make_plane(bb_sim)
+    control2.force_candidate(list(bench.rich_carrier()), "frozen")
+    fleet = SimTrainingFleet(
+        control=control2,
+        cost=CostModel(train_step_s=1e-3, wire_unit_s=bench.WIRE_UNIT),
+        params_fn=lambda step: {})
+    fleet.run(steps)
+
+    for bb in (bb_real, bb_sim):
+        evs = bb.events()
+        assert [e.kind for e in evs] == [
+            "trigger", "synthesize", "candidate_ready", "swap",
+            "rollback"]
+        trig, synth, ready, swap, rollback = evs
+        assert trig.parent_id is None
+        assert synth.parent_id == trig.event_id
+        assert ready.parent_id == synth.event_id
+        assert swap.parent_id == ready.event_id
+        assert rollback.parent_id == swap.event_id
+        assert [e.step for e in evs] == sorted(e.step for e in evs)
+        assert rollback.step > swap.step
+        # the terminal rollback resolved the whole chain's outcome
+        assert {e.outcome for e in evs} == {"rolled_back"}
+        assert "rollback" in bb.explain(trig)
+    # ...and the audit logs are byte-identical across real and sim:
+    # the probation health floats differ (measured vs scripted call
+    # sites), but they are detail-only
+    assert bb_real.chain_digest() == bb_sim.chain_digest()
+
+
 # ------------------------------------------------------------------ #
 # training: membership churn round-trip through the real controller
 # ------------------------------------------------------------------ #
